@@ -1,0 +1,29 @@
+"""apex_tpu — a TPU-native distributed prioritized experience replay (Ape-X) framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``Bing-Jing/Ape-X`` (PyTorch+CUDA): dueling double-DQN with n-step returns,
+prioritized replay on sum/min segment trees, ladder-epsilon CPU actor fleets,
+an asynchronous actor->replay->learner pipeline, an evaluator role, and the
+AQL action-proposal extension for continuous action spaces.
+
+Architecture stance (TPU-first, not a port):
+
+* The learner step — replay ingest, stratified prioritized sampling, loss,
+  backward, optimizer update, and priority write-back — is ONE jit-compiled
+  XLA program operating on donated HBM buffers (``apex_tpu.training.learner``).
+* The prioritized replay buffer is HBM-resident: flat ``jnp`` sum/min trees
+  with a vectorized fixed-depth descent instead of the reference's pointer-
+  chasing Python trees guarded by a single lock (``apex_tpu.replay``).
+* Multi-chip scaling uses ``jax.sharding.Mesh`` + ``shard_map`` with
+  ``psum`` gradient all-reduce over ICI, in place of the role NCCL would
+  play (``apex_tpu.parallel``).
+* Actors stay host-CPU Python processes; the host<->device plane is
+  double-buffered staging feeding ``jax.device_put``; the host<->host plane
+  is ZeroMQ with the reference's backpressure semantics (``apex_tpu.runtime``).
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import config as config
+
+__all__ = ["config", "__version__"]

@@ -1,0 +1,172 @@
+"""Configuration system.
+
+Replaces the reference's single shared argparse (``origin_repo/arguments.py:5-83``)
+plus env-var role identity (``origin_repo/actor.py:18-25``,
+``origin_repo/learner.py:23-27``) with typed dataclasses.  Defaults reproduce the
+reference's hyperparameters behind its published numbers
+(``origin_repo/arguments.py:9-74``), with TPU-specific knobs added (mesh shape,
+compute dtype, replay residency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+@dataclass(frozen=True)
+class ReplayConfig:
+    """Prioritized replay hyperparameters (reference: arguments.py:41-50)."""
+
+    capacity: int = 2 ** 21          # reference buffer 2e6, rounded to a power of 2
+    alpha: float = 0.6               # priority exponent
+    beta: float = 0.4                # IS-weight exponent (annealed toward 1 by drivers)
+    warmup: int = 50_000             # learner gated until this many transitions (arguments.py:47-48)
+    eps: float = 1e-6                # priority floor added to |td|
+    # TPU knobs
+    device_resident: bool = True     # HBM struct-of-arrays vs. host (C++/numpy) buffer
+    frame_pool: bool = False         # dedup frame-pool storage layout for stacked pixels
+
+    def __post_init__(self) -> None:
+        if self.capacity & (self.capacity - 1):
+            raise ValueError(f"capacity must be a power of 2, got {self.capacity}")
+
+
+@dataclass(frozen=True)
+class LearnerConfig:
+    """Learner-loop hyperparameters (reference: arguments.py:49-66, ApeX.py:37)."""
+
+    batch_size: int = 512
+    lr: float = 6.25e-5
+    rmsprop_decay: float = 0.95      # torch RMSprop alpha (ApeX.py:37)
+    rmsprop_eps: float = 1.5e-7
+    rmsprop_centered: bool = True
+    gamma: float = 0.99
+    n_steps: int = 3
+    max_grad_norm: float = 40.0
+    target_update_interval: int = 2500
+    publish_interval: int = 25       # param publish period, learner steps
+    save_interval: int = 5000
+    # TPU knobs
+    compute_dtype: str = "bfloat16"  # MXU-native matmul dtype; params stay f32
+    ingest_chunk: int = 512          # transitions folded into each fused step
+    mesh_shape: tuple[int, ...] = (1,)
+    mesh_axes: tuple[str, ...] = ("dp",)
+
+
+@dataclass(frozen=True)
+class ActorConfig:
+    """Actor-fleet hyperparameters (reference: arguments.py:9-40, batchrecorder.py:121)."""
+
+    n_actors: int = 8
+    send_interval: int = 50          # transitions per shipped batch
+    update_interval: int = 400       # env steps between param refresh polls
+    eps_base: float = 0.4            # per-actor ladder eps_base^(1 + i/(N-1)*eps_alpha)
+    eps_alpha: float = 7.0
+    max_episode_length: int = 50_000
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    env_id: str = "SeaquestNoFrameskip-v4"   # reference default (arguments.py:9-10)
+    frame_stack: int = 4
+    frame_skip: int = 4
+    episodic_life: bool = True
+    clip_rewards: bool = True
+    seed: int = 1122                 # reference default seed (arguments.py:14)
+
+
+@dataclass(frozen=True)
+class AQLConfig:
+    """AQL proposal-action Q-learning knobs (reference: model.py:170, AQL.py:41-42)."""
+
+    propose_sample: int = 100
+    uniform_sample: int = 400
+    action_var: float = 0.25
+    proposal_lr: float = 1e-4
+    q_lr: float = 1e-4
+    entropy_coef: float = 0.01
+
+
+@dataclass(frozen=True)
+class CommsConfig:
+    """Multi-host plane (reference: replay.py:48-74, learner.py:57-68, actor.py:110-114)."""
+
+    replay_ip: str = "127.0.0.1"
+    learner_ip: str = "127.0.0.1"
+    batch_port: int = 51001          # actor -> replay transition stream
+    prios_port: int = 51002          # learner -> replay priority updates
+    sample_port: int = 51003         # replay -> learner sampled batches
+    param_port: int = 52001          # learner PUB param broadcast
+    barrier_port: int = 52002        # startup handshake ROUTER
+    max_outstanding_sends: int = 3   # actor credit window (actor.py:110-112)
+    max_outstanding_prios: int = 16  # learner->replay window (learner.py:121-127)
+    param_hwm: int = 3               # PUB high-water mark (learner.py:60)
+    n_recv_batch_procs: int = 4      # learner-side pullers (arguments.py:73-74)
+
+
+@dataclass(frozen=True)
+class ApexConfig:
+    """Top-level bundle; one object configures every role."""
+
+    env: EnvConfig = field(default_factory=EnvConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
+    learner: LearnerConfig = field(default_factory=LearnerConfig)
+    actor: ActorConfig = field(default_factory=ActorConfig)
+    aql: AQLConfig = field(default_factory=AQLConfig)
+    comms: CommsConfig = field(default_factory=CommsConfig)
+
+    def replace(self, **sections: Any) -> "ApexConfig":
+        return dataclasses.replace(self, **sections)
+
+
+@dataclass(frozen=True)
+class RoleIdentity:
+    """Process role identity, injected via env vars by deploy scripts
+    (reference: deploy/actor.sh:4-9; actor.py:18-25)."""
+
+    role: str = "learner"            # learner | actor | replay | evaluator
+    actor_id: int = 0
+    n_actors: int = 1
+    replay_ip: str = "127.0.0.1"
+    learner_ip: str = "127.0.0.1"
+
+    @classmethod
+    def from_env(cls, environ: os._Environ | dict | None = None) -> "RoleIdentity":
+        e = dict(environ if environ is not None else os.environ)
+        return cls(
+            role=e.get("APEX_ROLE", "learner"),
+            actor_id=int(e.get("ACTOR_ID", 0)),
+            n_actors=int(e.get("N_ACTORS", 1)),
+            replay_ip=e.get("REPLAY_IP", "127.0.0.1"),
+            learner_ip=e.get("LEARNER_IP", "127.0.0.1"),
+        )
+
+
+def small_test_config(
+    capacity: int = 1024,
+    batch_size: int = 32,
+    n_actors: int = 2,
+    env_id: str = "ApexCartPole-v0",
+) -> ApexConfig:
+    """A config sized for CI: tiny buffer, tiny batch, numpy-native env."""
+    return ApexConfig(
+        env=EnvConfig(env_id=env_id, frame_stack=1, clip_rewards=False,
+                      episodic_life=False),
+        replay=ReplayConfig(capacity=capacity, warmup=max(2 * batch_size, 64)),
+        learner=LearnerConfig(batch_size=batch_size, ingest_chunk=batch_size,
+                              target_update_interval=100, compute_dtype="float32"),
+        actor=ActorConfig(n_actors=n_actors, send_interval=16),
+    )
+
+
+def flat_dict(cfg: ApexConfig) -> dict[str, Any]:
+    """Pretty/loggable flattened view (reference: utils.print_args, utils.py:9-12)."""
+    out: dict[str, Any] = {}
+    for section in dataclasses.fields(cfg):
+        sub = getattr(cfg, section.name)
+        for f in dataclasses.fields(sub):
+            out[f"{section.name}.{f.name}"] = getattr(sub, f.name)
+    return out

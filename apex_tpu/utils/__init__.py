@@ -1,0 +1,4 @@
+from apex_tpu.utils.seeding import set_global_seeds, split_key
+from apex_tpu.utils.metrics import RateCounter, MetricLogger
+
+__all__ = ["set_global_seeds", "split_key", "RateCounter", "MetricLogger"]

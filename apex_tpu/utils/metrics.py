@@ -1,0 +1,64 @@
+"""Throughput counters and metric logging.
+
+The reference's observability is wall-clock BPS prints on the learner
+(``origin_repo/learner.py:171-175``) and per-role tensorboardX scalars
+(``learner.py:160-174``, ``actor.py:91-92``, ``eval.py:79-80``).  We keep the
+same name-spaced scalar scheme and add steps/sec/chip + env-frames/sec — the
+BASELINE.json primary metric."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any
+
+
+class RateCounter:
+    """Sliding-window events/sec (learner BPS, actor FPS)."""
+
+    def __init__(self, window: int = 100):
+        self._times: deque[float] = deque(maxlen=window)
+        self.total = 0
+
+    def tick(self, n: int = 1) -> None:
+        self.total += n
+        self._times.append(time.perf_counter())
+
+    @property
+    def rate(self) -> float:
+        if len(self._times) < 2:
+            return 0.0
+        span = self._times[-1] - self._times[0]
+        return 0.0 if span <= 0 else (len(self._times) - 1) / span
+
+
+class MetricLogger:
+    """Name-spaced scalar logger; tensorboardX if available, always stdout-capable."""
+
+    def __init__(self, role: str, logdir: str | None = None, verbose: bool = False):
+        self.role = role
+        self.verbose = verbose
+        self._writer = None
+        if logdir is not None:
+            try:
+                from tensorboardX import SummaryWriter
+                self._writer = SummaryWriter(logdir)
+            except Exception:
+                self._writer = None
+        self.history: dict[str, list[tuple[int, float]]] = {}
+
+    def scalar(self, name: str, value: float, step: int) -> None:
+        tag = f"{self.role}/{name}"
+        self.history.setdefault(tag, []).append((step, float(value)))
+        if self._writer is not None:
+            self._writer.add_scalar(tag, value, step)
+        if self.verbose:
+            print(f"[{tag}] step={step} {value:.6g}", flush=True)
+
+    def scalars(self, values: dict[str, Any], step: int) -> None:
+        for k, v in values.items():
+            self.scalar(k, float(v), step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

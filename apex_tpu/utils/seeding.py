@@ -1,0 +1,26 @@
+"""Seeding discipline.
+
+The reference seeds three implicit global RNGs (``utils.py:15-22``).  On TPU the
+numeric path must use explicit ``jax.random`` keys threaded through every
+stochastic op (epsilon-greedy, NoisyNet noise, proposal sampling); numpy/stdlib
+seeding remains for host-side actors.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def set_global_seeds(seed: int) -> jax.Array:
+    """Seed host RNGs and return a root JAX key (reference: utils.py:15-22)."""
+    np.random.seed(seed)
+    random.seed(seed)
+    return jax.random.key(seed)
+
+
+def split_key(key: jax.Array, n: int = 2):
+    """Thin wrapper so call sites read uniformly."""
+    return jax.random.split(key, n)
