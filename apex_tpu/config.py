@@ -30,7 +30,7 @@ class ReplayConfig:
     frame_pool: bool = False         # dedup frame-pool storage layout for stacked pixels
 
     def __post_init__(self) -> None:
-        if self.capacity & (self.capacity - 1):
+        if self.capacity <= 0 or self.capacity & (self.capacity - 1):
             raise ValueError(f"capacity must be a power of 2, got {self.capacity}")
 
 

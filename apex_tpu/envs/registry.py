@@ -1,0 +1,105 @@
+"""Env construction: the reference's two composers re-expressed.
+
+``make_atari(env_id)`` (``origin_repo/wrapper.py:255-262``) and
+``wrap_atari_dqn(env, args)`` (``wrapper.py:316-329``) become one
+``make_env(env_id, cfg)`` that dispatches on the id:
+
+* ``Apex*`` ids -> numpy-native envs (no emulator needed; see
+  :mod:`apex_tpu.envs.toy`).  Pixel envs still get FrameStack so the
+  observation contract matches Atari exactly.
+* ``*NoFrameskip*`` ids -> the full DeepMind wrapper stack; requires
+  ``ale_py``, which this image does not ship — gated with a clear error.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import gymnasium as gym
+
+from apex_tpu.config import EnvConfig
+from apex_tpu.envs import toy, wrappers
+
+
+def _ale_available() -> bool:
+    try:
+        import ale_py  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def make_atari(env_id: str, skip: int = 4,
+               max_episode_steps: int | None = None) -> gym.Env:
+    """Base Atari env + Noop + MaxAndSkip (reference: wrapper.py:255-262)."""
+    if not _ale_available():
+        raise ImportError(
+            "ale_py is not installed; Atari envs are unavailable in this "
+            "image. Use 'ApexCartPole-v0' or 'ApexCatch-v0' instead.")
+    import ale_py
+    gym.register_envs(ale_py)
+    env = gym.make(env_id)
+    env = wrappers.NoopResetEnv(env, noop_max=30)
+    env = wrappers.MaxAndSkipEnv(env, skip=skip)
+    if max_episode_steps is not None:
+        env = wrappers.TimeLimit(env, max_episode_steps)
+    return env
+
+
+def wrap_atari_dqn(env: gym.Env, cfg: EnvConfig) -> gym.Env:
+    """DeepMind preprocessing stack (reference: wrapper.py:316-329)."""
+    if cfg.episodic_life:
+        env = wrappers.EpisodicLifeEnv(env)
+    if "FIRE" in env.unwrapped.get_action_meanings():
+        env = wrappers.FireResetEnv(env)
+    env = wrappers.WarpFrame(env)
+    if cfg.clip_rewards:
+        env = wrappers.ClipRewardEnv(env)
+    if cfg.frame_stack > 1:
+        env = wrappers.FrameStack(env, cfg.frame_stack)
+    return env
+
+
+def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
+             seed: int | None = None,
+             max_episode_steps: int | None = None) -> gym.Env:
+    """One-stop constructor used by every role (actor/evaluator/driver)."""
+    cfg = cfg or EnvConfig()
+    env_id = env_id or cfg.env_id
+
+    if env_id.startswith("ApexCartPole"):
+        env = toy.CartPoleEnv()
+    elif env_id.startswith("ApexCatch"):
+        env = toy.CatchEnv()
+        if cfg.frame_stack > 1:
+            env = wrappers.FrameStack(env, cfg.frame_stack)
+    else:
+        env = make_atari(env_id, skip=cfg.frame_skip,
+                         max_episode_steps=max_episode_steps)
+        env = wrap_atari_dqn(env, cfg)
+
+    if seed is not None:
+        env.reset(seed=seed)
+        env.action_space.seed(seed)
+    return env
+
+
+def make_eval_env(env_id: str | None = None, cfg: EnvConfig | None = None,
+                  seed: int | None = None) -> gym.Env:
+    """Evaluation env: UNCLIPPED rewards, full episodes (no EpisodicLife) —
+    the reference evaluator measures true game score this way
+    (``origin_repo/eval.py:52``)."""
+    import dataclasses
+    cfg = cfg or EnvConfig()
+    eval_cfg = dataclasses.replace(cfg, clip_rewards=False,
+                                   episodic_life=False)
+    return make_env(env_id, eval_cfg, seed=seed)
+
+
+def obs_spec(env: gym.Env) -> tuple[tuple[int, ...], Any]:
+    space = env.observation_space
+    return tuple(space.shape), space.dtype
+
+
+def num_actions(env: gym.Env) -> int:
+    return int(env.action_space.n)

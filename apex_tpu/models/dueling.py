@@ -1,0 +1,112 @@
+"""Dueling DQN in flax.linen, NHWC/TPU-native.
+
+Capability parity with the reference ``DuelingDQN`` (``model.py:14-107``):
+Nature-DQN conv trunk (32x8s4 / 64x4s2 / 64x3s1, ``model.py:32-39``) for 3-D
+observations or a 128-unit MLP trunk for 1-D (``model.py:40-45``), dueling
+value/advantage heads of width 128 (``model.py:48-58``), aggregation
+``V + A - mean(A)`` (``model.py:68``), orthogonal init with ReLU gain and zero
+bias (``model.py:97-107``).
+
+TPU-first deltas (deliberate, not drift):
+
+* **NHWC layout** — the reference is channel-first (``wrapper.py:301-313``);
+  XLA:TPU's conv tiling is NHWC-native, so observations are stored and fed
+  ``(H, W, stack)``.
+* **uint8 in, scale in-model** — the reference scales frames on the host
+  (``wrapper.py:207-215``); we keep replay/wire traffic uint8 (4x less HBM
+  bandwidth) and fold ``/255`` into the first op of the compiled graph.
+* **bfloat16 compute** — matmuls/convs run in bf16 on the MXU, params and the
+  head output stay f32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+_RELU_GAIN = jnp.sqrt(2.0)  # torch nn.init.calculate_gain('relu')
+
+
+def orthogonal_init(gain: float = _RELU_GAIN):
+    return nn.initializers.orthogonal(scale=gain)
+
+
+class DuelingDQN(nn.Module):
+    """Q-network with dueling heads.
+
+    Attributes:
+      num_actions: size of the discrete action space.
+      obs_is_image: 3-D pixel observations (conv trunk) vs 1-D (MLP trunk).
+      compute_dtype: matmul/conv dtype (bf16 for the MXU); outputs f32.
+      scale_uint8: divide image input by 255 inside the graph.
+    """
+
+    num_actions: int
+    obs_is_image: bool = True
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    scale_uint8: bool = True
+    trunk_features: Sequence[int] = (32, 64, 64)
+    head_width: int = 128
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dt = self.compute_dtype
+        if x.dtype == jnp.uint8 and self.scale_uint8:
+            x = x.astype(dt) / jnp.asarray(255.0, dt)
+        else:
+            x = x.astype(dt)
+
+        if self.obs_is_image:
+            f1, f2, f3 = self.trunk_features
+            for feats, kernel, stride in (
+                    (f1, (8, 8), (4, 4)),
+                    (f2, (4, 4), (2, 2)),
+                    (f3, (3, 3), (1, 1))):
+                x = nn.Conv(feats, kernel, strides=stride, padding="VALID",
+                            dtype=dt, kernel_init=orthogonal_init(),
+                            bias_init=nn.initializers.zeros)(x)
+                x = nn.relu(x)
+            x = x.reshape((x.shape[0], -1))
+        else:
+            x = nn.Dense(128, dtype=dt, kernel_init=orthogonal_init(),
+                         bias_init=nn.initializers.zeros)(x)
+            x = nn.relu(x)
+
+        def head(out_dim: int, name: str) -> jax.Array:
+            h = nn.Dense(self.head_width, dtype=dt,
+                         kernel_init=orthogonal_init(),
+                         bias_init=nn.initializers.zeros,
+                         name=f"{name}_hidden")(x)
+            h = nn.relu(h)
+            return nn.Dense(out_dim, dtype=dt,
+                            kernel_init=orthogonal_init(),
+                            bias_init=nn.initializers.zeros,
+                            name=f"{name}_out")(h)
+
+        advantage = head(self.num_actions, "advantage").astype(jnp.float32)
+        value = head(1, "value").astype(jnp.float32)
+        return value + advantage - advantage.mean(axis=1, keepdims=True)
+
+
+def make_policy_fn(model: DuelingDQN):
+    """Jittable epsilon-greedy policy (reference ``DuelingDQN.act``,
+    ``model.py:74-86``): returns ``(actions, q_values)`` so actors can compute
+    initial TD priorities without re-running the network (``memory.py:396``).
+
+    Vectorized over a batch of states — one call serves a whole vectorized
+    env fleet, unlike the reference's single-state ``act``.
+    """
+
+    def policy(params, obs: jax.Array, epsilon: jax.Array, key: jax.Array):
+        q_values = model.apply(params, obs)
+        explore_key, action_key = jax.random.split(key)
+        greedy = q_values.argmax(axis=1)
+        random_actions = jax.random.randint(
+            action_key, greedy.shape, 0, model.num_actions)
+        explore = jax.random.uniform(explore_key, greedy.shape) < epsilon
+        return jnp.where(explore, random_actions, greedy), q_values
+
+    return policy

@@ -1,0 +1,60 @@
+"""Factorized NoisyNet linear layer (Fortunato et al. 2017).
+
+Parity with the reference ``NoisyLinear`` (``model.py:112-164``): mu + sigma
+parameters with mu ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in)) and sigma =
+std_init/sqrt(fan_in); factorized noise eps_out (x) eps_in with
+``sign(x)*sqrt(|x|)`` scaling; deterministic (mu-only) eval mode.
+
+TPU-first delta: the reference keeps noise in mutable buffers refreshed by an
+explicit ``reset_noise()`` side effect (``model.py:154-159``).  Here noise is
+drawn functionally from a ``'noise'`` PRNG collection each application —
+``apply(..., rngs={'noise': key})`` IS the noise reset, which jits cleanly and
+makes per-step noise refresh (``AQL_dis.py:104-105``) the default behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _scale_noise(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class NoisyDense(nn.Module):
+    features: int
+    std_init: float = 0.4
+    deterministic: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        mu_range = 1.0 / jnp.sqrt(in_features)
+
+        def mu_init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, -mu_range, mu_range)
+
+        def sigma_init(key, shape, dtype=jnp.float32):
+            del key
+            return jnp.full(shape, self.std_init / jnp.sqrt(in_features), dtype)
+
+        w_mu = self.param("w_mu", mu_init, (in_features, self.features))
+        w_sigma = self.param("w_sigma", sigma_init, (in_features, self.features))
+        b_mu = self.param("b_mu", mu_init, (self.features,))
+        b_sigma = self.param("b_sigma", sigma_init, (self.features,))
+
+        if self.deterministic:
+            w, b = w_mu, b_mu
+        else:
+            key = self.make_rng("noise")
+            k_in, k_out = jax.random.split(key)
+            eps_in = _scale_noise(jax.random.normal(k_in, (in_features,)))
+            eps_out = _scale_noise(jax.random.normal(k_out, (self.features,)))
+            w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
+            b = b_mu + b_sigma * eps_out
+
+        dt = self.compute_dtype
+        return (x.astype(dt) @ w.astype(dt) + b.astype(dt)).astype(jnp.float32)

@@ -1,0 +1,135 @@
+"""Device-resident segment trees for prioritized replay.
+
+TPU re-design of the reference's pointer-chasing Python trees
+(``memory.py:10-143``): the tree is ONE flat ``jnp`` array of length
+``2 * capacity`` living in HBM.  Node 1 is the root; node ``i`` has children
+``2i`` and ``2i+1``; leaves occupy ``[capacity, 2*capacity)``.  Every operation
+is vectorized over a batch of indices and expressed as fixed-depth gather/
+scatter loops, so the whole thing traces into a single XLA program — there is
+no per-element Python, no locks, and updates for a K-sized batch cost
+``O(K log C)`` fully-parallel work.
+
+Semantics match the reference exactly:
+
+* ``update_*`` — leaf write + root-ward recomputation (``memory.py:76-87``).
+* ``find_prefixsum_idx`` — iterative descent, descending LEFT when
+  ``left_subtree_sum > u`` else RIGHT with ``u -= left_subtree_sum``
+  (``memory.py:106-129``).
+* ``stratified_sample`` — batch-size strata, one uniform draw per stratum:
+  ``u_i = (i + U_i) * total / B`` (``memory.py:242-250``).
+
+Capacity must be a power of 2 (asserted by the reference at ``memory.py:34``;
+here it is implied by the array length).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_sum_tree(capacity: int) -> jax.Array:
+    _check_capacity(capacity)
+    return jnp.zeros(2 * capacity, dtype=jnp.float32)
+
+
+def init_min_tree(capacity: int) -> jax.Array:
+    _check_capacity(capacity)
+    return jnp.full(2 * capacity, jnp.inf, dtype=jnp.float32)
+
+
+def _check_capacity(capacity: int) -> None:
+    if capacity <= 0 or capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a positive power of 2, got {capacity}")
+
+
+def capacity_of(tree: jax.Array) -> int:
+    return tree.shape[0] // 2
+
+
+def depth_of(tree: jax.Array) -> int:
+    return (tree.shape[0] // 2).bit_length() - 1
+
+
+def _propagate(tree: jax.Array, leaf_nodes: jax.Array, reduce_op) -> jax.Array:
+    """Recompute ancestors of ``leaf_nodes`` level by level.
+
+    Duplicate parents in a level all write the same recomputed value, so
+    scatter-set with duplicates is well-defined.  The loop is unrolled at
+    trace time (depth = log2(capacity), e.g. 21 for a 2M buffer).
+    """
+    nodes = leaf_nodes // 2
+    for _ in range(depth_of(tree)):
+        tree = tree.at[nodes].set(reduce_op(tree[2 * nodes], tree[2 * nodes + 1]))
+        nodes = nodes // 2
+    return tree
+
+
+def update_sum(tree: jax.Array, idx: jax.Array, values: jax.Array) -> jax.Array:
+    """Set leaves ``idx`` (buffer coordinates, 0-based) to ``values``."""
+    leaf = idx + capacity_of(tree)
+    tree = tree.at[leaf].set(values.astype(tree.dtype))
+    return _propagate(tree, leaf, jnp.add)
+
+
+def update_min(tree: jax.Array, idx: jax.Array, values: jax.Array) -> jax.Array:
+    leaf = idx + capacity_of(tree)
+    tree = tree.at[leaf].set(values.astype(tree.dtype))
+    return _propagate(tree, leaf, jnp.minimum)
+
+
+def update_both(sum_tree: jax.Array, min_tree: jax.Array,
+                idx: jax.Array, values: jax.Array):
+    """Fused sum+min leaf update — one call per priority write
+    (reference merges add+update for the same reason, ``memory.py:334-346``)."""
+    return update_sum(sum_tree, idx, values), update_min(min_tree, idx, values)
+
+
+def tree_total(sum_tree: jax.Array) -> jax.Array:
+    return sum_tree[1]
+
+
+def tree_min(min_tree: jax.Array) -> jax.Array:
+    return min_tree[1]
+
+
+def get_leaves(tree: jax.Array, idx: jax.Array) -> jax.Array:
+    return tree[idx + capacity_of(tree)]
+
+
+def find_prefixsum_idx(sum_tree: jax.Array, u: jax.Array) -> jax.Array:
+    """Vectorized root-to-leaf descent (reference: ``memory.py:106-129``).
+
+    ``u`` may have any batch shape; returns leaf indices in buffer
+    coordinates.  Each level is one gather over the batch; the level loop is
+    unrolled at trace time.
+
+    Note: duplicate indices within one batched ``update_*`` call must carry
+    equal values (the sampled-batch case: one transition sampled twice gets
+    one TD error); distinct values for the same index are scatter-order
+    dependent.
+    """
+    node = jnp.ones(u.shape, dtype=jnp.int32)
+    u = u.astype(sum_tree.dtype)
+    for _ in range(depth_of(sum_tree)):
+        left = sum_tree[2 * node]
+        go_right = u >= left
+        u = jnp.where(go_right, u - left, u)
+        node = 2 * node + go_right.astype(jnp.int32)
+    return node - capacity_of(sum_tree)
+
+
+def stratified_sample(sum_tree: jax.Array, key: jax.Array, batch_size: int,
+                      size: jax.Array) -> jax.Array:
+    """Proportional stratified sampling (reference: ``memory.py:242-250``).
+
+    Draws one index per stratum ``[i, i+1) * total / B``.  ``size`` (current
+    element count) clamps the result so float round-off at stratum boundaries
+    can never select an empty leaf.
+    """
+    total = tree_total(sum_tree)
+    offsets = jax.random.uniform(key, (batch_size,), dtype=sum_tree.dtype)
+    u = (jnp.arange(batch_size, dtype=sum_tree.dtype) + offsets) * (
+        total / batch_size)
+    idx = find_prefixsum_idx(sum_tree, u)
+    return jnp.clip(idx, 0, jnp.maximum(size - 1, 0))

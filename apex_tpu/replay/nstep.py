@@ -1,0 +1,112 @@
+"""Actor-side n-step transition accumulation.
+
+Capability parity with the reference ``BatchStorage`` (``memory.py:393-478``):
+a per-actor sliding window emits ``(s_t, a_t, R_t^(n), s_{t+n}, done)`` with
+the Q-values observed while acting stored alongside, so initial TD priorities
+are computed WITHOUT re-running the network (``memory.py:396-397,451-464``) —
+the key Ape-X trick that keeps priority computation on the actor.
+
+Semantics delta (deliberate correction, not drift): the reference's flush
+accumulates n+1 rewards (``memory.py:418`` passes the deque's n rewards plus
+the current one to ``multi_step_reward``) while the learner bootstraps with
+``gamma ** n`` (``utils.py:74``), double-counting the boundary reward.  Here
+the emitted return is the textbook n-step sum of exactly n rewards,
+``R = sum_{i<n} gamma^i r_{t+i}``, bootstrapped by ``gamma^n max_a Q(s_{t+n})``
+— consistent with the loss in :mod:`apex_tpu.ops.losses`.  On episode end the
+tail of the window flushes with shorter reward sums and ``done=1`` (bootstrap
+masked), matching the reference's flush-on-done (``memory.py:416,432-435``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+
+class NStepAccumulator:
+    """Single-environment accumulator. For fleets, keep one per env slot."""
+
+    def __init__(self, n_steps: int, gamma: float = 0.99):
+        self.n_steps = n_steps
+        self.gamma = gamma
+        self._window: deque = deque()
+        self._out: dict[str, list] = self._empty_out()
+
+    @staticmethod
+    def _empty_out() -> dict[str, list]:
+        return {k: [] for k in ("obs", "action", "reward", "next_obs", "done",
+                                "q0", "qn")}
+
+    def add(self, obs: Any, action: int, reward: float,
+            q_values: np.ndarray, done: bool) -> None:
+        """Record one env step: ``obs`` is the state acted on, ``reward``/
+        ``done`` the step outcome, ``q_values`` the network output at ``obs``."""
+        self._window.append((obs, action, reward, q_values))
+        if len(self._window) == self.n_steps + 1:
+            self._emit(bootstrap=True)
+            self._window.popleft()
+        if done:
+            terminal_obs = self._window[-1][0]
+            while self._window:
+                self._emit(bootstrap=False, terminal_obs=terminal_obs)
+                self._window.popleft()
+
+    def _emit(self, bootstrap: bool, terminal_obs: Any = None) -> None:
+        """Emit the oldest windowed transition."""
+        w = self._window
+        obs0, action0, _, q0 = w[0]
+        ret = 0.0
+        for i in range(len(w) if not bootstrap else self.n_steps):
+            ret += (self.gamma ** i) * w[i][2]
+        if bootstrap:
+            next_obs, qn = w[self.n_steps][0], w[self.n_steps][3]
+            done = 0.0
+        else:
+            next_obs, qn = terminal_obs, w[-1][3]
+            done = 1.0
+        o = self._out
+        o["obs"].append(obs0)
+        o["action"].append(action0)
+        o["reward"].append(np.float32(ret))
+        o["next_obs"].append(next_obs)
+        o["done"].append(np.float32(done))
+        o["q0"].append(q0)
+        o["qn"].append(qn)
+
+    def __len__(self) -> int:
+        return len(self._out["obs"])
+
+    def compute_priorities(self) -> np.ndarray:
+        """Initial TD priorities from stored Q-values (``memory.py:451-464``)."""
+        o = self._out
+        actions = np.asarray(o["action"])
+        rewards = np.asarray(o["reward"], np.float32)
+        dones = np.asarray(o["done"], np.float32)
+        q0 = np.stack(o["q0"])
+        qn = np.stack(o["qn"])
+        q_taken = q0[np.arange(len(q0)), actions]
+        target = rewards + (self.gamma ** self.n_steps) * qn.max(1) * (1 - dones)
+        return np.abs(target - q_taken).astype(np.float32) + 1e-6
+
+    def make_batch(self) -> tuple[dict[str, np.ndarray], np.ndarray]:
+        """Materialize accumulated transitions + priorities, then reset
+        (``memory.py:466-469``).  LazyFrames force-materialize here — the one
+        host-side copy before the wire/device."""
+        prios = self.compute_priorities()
+        o = self._out
+        batch = dict(
+            obs=np.stack([np.asarray(x) for x in o["obs"]]),
+            action=np.asarray(o["action"], np.int32),
+            reward=np.asarray(o["reward"], np.float32),
+            next_obs=np.stack([np.asarray(x) for x in o["next_obs"]]),
+            done=np.asarray(o["done"], np.float32),
+        )
+        self._out = self._empty_out()
+        return batch, prios
+
+    def reset(self) -> None:
+        """Drop window and pending output (new episode hard reset)."""
+        self._window.clear()
+        self._out = self._empty_out()

@@ -1,0 +1,37 @@
+"""Explicit train-state pytree.
+
+The reference scatters learner state across a torch module, a target module,
+an optimizer object, and loop-local counters (``ApeX.py:32-43``,
+``DQN.py:100-115``).  Here everything the learner mutates is ONE pytree, so a
+step is a pure function, checkpointing is whole-state by construction
+(improving on the reference's weights-only saves, ``learner.py:166-168``), and
+sharding annotations apply uniformly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    target_params: Any
+    opt_state: Any
+    step: jax.Array               # i32 scalar — learner update count
+
+
+def create_train_state(model, optimizer: optax.GradientTransformation,
+                       key: jax.Array, example_obs: jax.Array) -> TrainState:
+    params = model.init(key, example_obs)
+    return TrainState(
+        params=params,
+        target_params=jax.tree.map(jnp.copy, params),
+        opt_state=optimizer.init(params),
+        step=jnp.int32(0),
+    )

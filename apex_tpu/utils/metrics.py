@@ -17,19 +17,20 @@ class RateCounter:
     """Sliding-window events/sec (learner BPS, actor FPS)."""
 
     def __init__(self, window: int = 100):
-        self._times: deque[float] = deque(maxlen=window)
+        self._ticks: deque[tuple[float, int]] = deque(maxlen=window)
         self.total = 0
 
     def tick(self, n: int = 1) -> None:
         self.total += n
-        self._times.append(time.perf_counter())
+        self._ticks.append((time.perf_counter(), n))
 
     @property
     def rate(self) -> float:
-        if len(self._times) < 2:
+        if len(self._ticks) < 2:
             return 0.0
-        span = self._times[-1] - self._times[0]
-        return 0.0 if span <= 0 else (len(self._times) - 1) / span
+        span = self._ticks[-1][0] - self._ticks[0][0]
+        events = sum(n for _, n in list(self._ticks)[1:])
+        return 0.0 if span <= 0 else events / span
 
 
 class MetricLogger:
@@ -43,13 +44,16 @@ class MetricLogger:
             try:
                 from tensorboardX import SummaryWriter
                 self._writer = SummaryWriter(logdir)
-            except Exception:
+            except Exception as e:
+                import warnings
+                warnings.warn(f"tensorboard writer unavailable for {logdir}: {e}")
                 self._writer = None
-        self.history: dict[str, list[tuple[int, float]]] = {}
+        self.history: dict[str, deque[tuple[int, float]]] = {}
 
     def scalar(self, name: str, value: float, step: int) -> None:
         tag = f"{self.role}/{name}"
-        self.history.setdefault(tag, []).append((step, float(value)))
+        self.history.setdefault(tag, deque(maxlen=100_000)).append(
+            (step, float(value)))
         if self._writer is not None:
             self._writer.add_scalar(tag, value, step)
         if self.verbose:

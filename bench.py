@@ -1,0 +1,76 @@
+"""Headline benchmark: learner batches/sec on one TPU chip.
+
+Reference baseline: 10-12 batches/s at batch 512 on a V100 learner fed by a
+separate replay server (``origin_repo/README.md:42``; BASELINE.md).  We
+measure the SAME unit of work, harder: each learner step here also ingests
+512 fresh transitions and performs the PER priority write-back on-device —
+work the reference offloads to its replay server — fused into one XLA
+program on the Atari-shape DuelingDQN (84x84x4 uint8, batch 512, 2^20 PER
+capacity).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = value / 11.0 (midpoint of the reference's 10-12 range).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_BPS = 11.0
+BATCH = 512
+OBS_SHAPE = (84, 84, 4)
+CAPACITY = 2 ** 20
+WARMUP_STEPS = 3
+MEASURE_STEPS = 50
+
+
+def main() -> None:
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.training.learner import build_learner
+
+    model = DuelingDQN(num_actions=6)
+    example_obs = jnp.zeros((1,) + OBS_SHAPE, jnp.uint8)
+    core, ts, rs = build_learner(
+        model, CAPACITY, example_obs, jax.random.key(0), batch_size=BATCH,
+        target_update_interval=2500)
+
+    rng = np.random.default_rng(0)
+    host = dict(
+        obs=rng.integers(0, 255, (BATCH,) + OBS_SHAPE).astype(np.uint8),
+        action=rng.integers(0, 6, BATCH).astype(np.int32),
+        reward=rng.normal(size=BATCH).astype(np.float32),
+        next_obs=rng.integers(0, 255, (BATCH,) + OBS_SHAPE).astype(np.uint8),
+        done=np.zeros(BATCH, np.float32))
+    ingest = jax.device_put(host)
+    prios = jnp.ones(BATCH, jnp.float32)
+
+    fused = core.jit_fused_step()
+    # pre-fill past a warmup's worth so sampling has mass
+    for i in range(WARMUP_STEPS):
+        ts, rs, metrics = fused(ts, rs, ingest, prios, jax.random.key(i),
+                                jnp.float32(0.4))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        ts, rs, metrics = fused(ts, rs, ingest, prios,
+                                jax.random.key(100 + i), jnp.float32(0.4))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    bps = MEASURE_STEPS / dt
+    print(json.dumps({
+        "metric": "learner_batches_per_sec_batch512_with_per_ingest",
+        "value": round(bps, 2),
+        "unit": "batches/s",
+        "vs_baseline": round(bps / BASELINE_BPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Canonical test entry point.
+#
+# PALLAS_AXON_POOL_IPS must be cleared BEFORE the interpreter starts: the
+# image's sitecustomize dials the single-client axon TPU relay at python
+# startup, and a test run would block forever if any other process holds the
+# chip (conftest.py runs too late to prevent the dial).  Tests always run on
+# the 8-device virtual CPU mesh (tests/conftest.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ "$@"

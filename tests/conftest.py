@@ -3,7 +3,10 @@ multi-chip path runs in CI without TPUs (SURVEY.md §4 implication)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): the image presets JAX_PLATFORMS=axon, and the
+# axon TPU tunnel serves one client at a time — concurrent test runs would
+# block forever on its TCP socket.  Tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

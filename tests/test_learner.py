@@ -1,0 +1,104 @@
+"""Fused learner step: mechanics (target sync, priority write-back, donation)
+and a small end-to-end learning test on the numpy CartPole env."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.config import small_test_config
+from apex_tpu.models.dueling import DuelingDQN
+from apex_tpu.training.learner import build_learner
+from apex_tpu.training.dqn import DQNTrainer
+
+
+def _setup(key, batch_size=16, capacity=256, target_interval=5):
+    model = DuelingDQN(num_actions=3, obs_is_image=False,
+                      compute_dtype=jnp.float32, scale_uint8=False)
+    example = jnp.zeros((1, 6), jnp.float32)
+    core, ts, rs = build_learner(
+        model, capacity, example, key, batch_size=batch_size,
+        n_steps=3, target_update_interval=target_interval)
+    return core, ts, rs
+
+
+def _fill(core, rs, n, seed=0):
+    rng = np.random.default_rng(seed)
+    batch = dict(
+        obs=rng.normal(size=(n, 6)).astype(np.float32),
+        action=rng.integers(0, 3, n).astype(np.int32),
+        reward=rng.normal(size=n).astype(np.float32),
+        next_obs=rng.normal(size=(n, 6)).astype(np.float32),
+        done=np.zeros(n, np.float32))
+    return core.jit_ingest()(rs, batch, jnp.ones(n))
+
+
+def test_train_step_updates_params_and_priorities(key):
+    core, ts, rs = _setup(key)
+    rs = _fill(core, rs, 64)
+    step = core.jit_train_step()
+
+    p_before = jax.tree.leaves(ts.params)[0].copy()
+    sum_before = float(rs.sum_tree[1])
+    ts2, rs2, metrics = step(ts, rs, jax.random.key(1), jnp.float32(0.4))
+
+    assert int(ts2.step) == 1
+    assert not np.allclose(np.asarray(jax.tree.leaves(ts2.params)[0]),
+                           np.asarray(p_before))
+    assert float(rs2.sum_tree[1]) != sum_before  # priorities written back
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["grad_norm"]) > 0
+
+
+def test_target_sync_interval(key):
+    core, ts, rs = _setup(key, target_interval=3)
+    rs = _fill(core, rs, 64)
+    step = core.jit_train_step()
+
+    tgt0 = np.asarray(jax.tree.leaves(ts.target_params)[0]).copy()
+    for i in range(2):
+        ts, rs, _ = step(ts, rs, jax.random.key(i), jnp.float32(0.4))
+    # after 2 steps (< interval), target unchanged
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(ts.target_params)[0]), tgt0)
+    ts, rs, _ = step(ts, rs, jax.random.key(9), jnp.float32(0.4))
+    # at step 3 == interval, target == online
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(ts.target_params)[0]),
+        np.asarray(jax.tree.leaves(ts.params)[0]))
+
+
+def test_fused_step_ingests_and_trains(key):
+    core, ts, rs = _setup(key)
+    rs = _fill(core, rs, 32)
+    fused = core.jit_fused_step()
+    rng = np.random.default_rng(1)
+    batch = dict(
+        obs=rng.normal(size=(16, 6)).astype(np.float32),
+        action=rng.integers(0, 3, 16).astype(np.int32),
+        reward=rng.normal(size=16).astype(np.float32),
+        next_obs=rng.normal(size=(16, 6)).astype(np.float32),
+        done=np.zeros(16, np.float32))
+    ts2, rs2, metrics = fused(ts, rs, batch, jnp.ones(16),
+                              jax.random.key(2), jnp.float32(0.4))
+    assert int(rs2.size) == 48 and int(ts2.step) == 1
+
+
+def test_dqn_learns_cartpole():
+    """End-to-end slice: reward must clearly beat random play.
+
+    Random play on this CartPole lasts ~20 steps/episode; a learning agent
+    should exceed 60 within a small frame budget.  (The Pong>=18 north star
+    needs ALE + long runs; this is the CI-scale equivalent.)
+    """
+    cfg = small_test_config(capacity=4096, batch_size=64)
+    trainer = DQNTrainer(cfg, train_every=2)
+    trainer.epsilon.decay = 4000.0
+    trainer.train(total_frames=14_000)
+    # robust learning signal (RL variance at this scale makes a single eval
+    # threshold flaky): online episode reward must clearly improve AND the
+    # greedy policy must beat random play (~22/episode).
+    eps = [v for _, v in trainer.log.history["learner/episode_reward"]]
+    first, last = float(np.mean(eps[:20])), float(np.mean(eps[-20:]))
+    score = trainer.evaluate(episodes=5, epsilon=0.0, max_steps=500)
+    assert last > 1.5 * first, f"no training-curve improvement: {first}->{last}"
+    assert score > 40.0, f"eval reward {score} <= 40: not learning"

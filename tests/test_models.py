@@ -1,0 +1,77 @@
+"""Model shape/semantics tests: dueling aggregation, policy fn, NoisyDense."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+from apex_tpu.models.noisy import NoisyDense
+
+
+def test_dueling_conv_shapes_and_identifiability(key):
+    model = DuelingDQN(num_actions=6, compute_dtype=jnp.float32)
+    obs = jnp.zeros((2, 84, 84, 4), jnp.uint8)
+    params = model.init(key, obs)
+    q = model.apply(params, obs)
+    assert q.shape == (2, 6) and q.dtype == jnp.float32
+    # conv trunk output matches Nature-DQN geometry: 7*7*64 flattened
+    flat_in = params["params"]["advantage_hidden"]["kernel"].shape[0]
+    assert flat_in == 7 * 7 * 64
+
+
+def test_dueling_mlp_trunk(key):
+    model = DuelingDQN(num_actions=2, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=False)
+    obs = jnp.ones((3, 4), jnp.float32)
+    params = model.init(key, obs)
+    assert model.apply(params, obs).shape == (3, 2)
+
+
+def test_dueling_aggregation_mean_zero_advantage(key):
+    """V + A - mean(A): per-row advantage contribution must be mean-zero."""
+    model = DuelingDQN(num_actions=5, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=False)
+    obs = jax.random.normal(key, (4, 8))
+    params = model.init(key, obs)
+    q = model.apply(params, obs)
+    # reconstruct value head output; q - value must be mean-zero per row
+    centered = q - q.mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(centered.mean(axis=1)), 0.0,
+                               atol=1e-5)
+
+
+def test_policy_epsilon_extremes(key):
+    model = DuelingDQN(num_actions=4, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=False)
+    obs = jax.random.normal(key, (64, 8))
+    params = model.init(key, obs)
+    policy = jax.jit(make_policy_fn(model))
+
+    acts, q = policy(params, obs, jnp.float32(0.0), jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(acts), np.asarray(q.argmax(1)))
+
+    acts1, _ = policy(params, obs, jnp.float32(1.0), jax.random.key(2))
+    acts2, _ = policy(params, obs, jnp.float32(1.0), jax.random.key(3))
+    assert not np.array_equal(np.asarray(acts1), np.asarray(acts2))
+
+
+def test_noisy_dense_noise_and_determinism(key):
+    layer = NoisyDense(16)
+    x = jnp.ones((2, 8))
+    params = layer.init({"params": key, "noise": jax.random.key(1)}, x)
+
+    y1 = layer.apply(params, x, rngs={"noise": jax.random.key(10)})
+    y2 = layer.apply(params, x, rngs={"noise": jax.random.key(11)})
+    y3 = layer.apply(params, x, rngs={"noise": jax.random.key(10)})
+    assert not np.allclose(y1, y2)          # fresh noise differs
+    np.testing.assert_allclose(y1, y3)      # same key reproduces
+
+    det = NoisyDense(16, deterministic=True)
+    d1 = det.apply(params, x)
+    d2 = det.apply(params, x)
+    np.testing.assert_allclose(d1, d2)      # eval mode: mu only, no rng needed
+
+    # sigma init value matches reference: std_init/sqrt(fan_in)
+    np.testing.assert_allclose(
+        np.asarray(params["params"]["w_sigma"][0, 0]), 0.4 / np.sqrt(8),
+        rtol=1e-6)

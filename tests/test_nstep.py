@@ -1,0 +1,72 @@
+"""NStepAccumulator vs. a brute-force trajectory oracle."""
+
+import numpy as np
+
+from apex_tpu.replay.nstep import NStepAccumulator
+
+
+def _run_episode(acc, rewards, gamma, n):
+    """Feed a synthetic episode; obs at step t is t, q_values are fixed."""
+    T = len(rewards)
+    for t in range(T):
+        q = np.asarray([0.5, 1.5], np.float32)  # max=1.5, action 0 -> q=0.5
+        acc.add(obs=np.float32(t), action=0, reward=rewards[t],
+                q_values=q, done=(t == T - 1))
+
+
+def test_nstep_returns_match_bruteforce():
+    n, gamma = 3, 0.9
+    rewards = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    acc = NStepAccumulator(n, gamma)
+    _run_episode(acc, rewards, gamma, n)
+    batch, prios = acc.make_batch()
+
+    assert len(batch["obs"]) == 6  # every step emitted
+    # bootstrapped transitions: t=0,1,2 (episode len 6, window n=3)
+    for t in range(3):
+        want = sum(gamma ** i * rewards[t + i] for i in range(n))
+        np.testing.assert_allclose(batch["reward"][t], want, rtol=1e-6)
+        assert batch["done"][t] == 0.0
+        assert batch["obs"][t] == t and batch["next_obs"][t] == t + n
+    # terminal flush: t=3,4,5 get truncated sums and done=1
+    for t in range(3, 6):
+        want = sum(gamma ** i * rewards[t + i] for i in range(6 - t))
+        np.testing.assert_allclose(batch["reward"][t], want, rtol=1e-6)
+        assert batch["done"][t] == 1.0
+
+
+def test_priorities_match_manual_td():
+    n, gamma = 2, 0.99
+    acc = NStepAccumulator(n, gamma)
+    _run_episode(acc, [1.0, 1.0, 1.0], gamma, n)
+    batch, prios = acc.make_batch()
+    # t=0: bootstrap: R=1+0.99, target = R + 0.99^2*1.5, q_taken=0.5
+    want0 = abs((1 + 0.99) + 0.99 ** 2 * 1.5 - 0.5) + 1e-6
+    np.testing.assert_allclose(prios[0], want0, rtol=1e-5)
+    # terminal ones: target = R only
+    want_last = abs(1.0 - 0.5) + 1e-6
+    np.testing.assert_allclose(prios[-1], want_last, rtol=1e-5)
+    assert (prios > 0).all()
+
+
+def test_multi_episode_no_window_leak():
+    acc = NStepAccumulator(3, 0.99)
+    _run_episode(acc, [1.0, 1.0], 0.99, 3)   # short episode, all terminal
+    _run_episode(acc, [5.0] * 5, 0.99, 3)
+    batch, _ = acc.make_batch()
+    assert len(batch["obs"]) == 7
+    # first episode transitions must not see episode-2 rewards
+    np.testing.assert_allclose(batch["reward"][0], 1.0 + 0.99 * 1.0, rtol=1e-6)
+    assert batch["done"][0] == 1.0 and batch["done"][1] == 1.0
+
+
+def test_uint8_image_obs_roundtrip():
+    acc = NStepAccumulator(2, 0.99)
+    frames = [np.full((8, 8, 1), t, np.uint8) for t in range(4)]
+    for t in range(4):
+        acc.add(frames[t], action=1, reward=1.0,
+                q_values=np.asarray([0.0, 1.0], np.float32), done=(t == 3))
+    batch, _ = acc.make_batch()
+    assert batch["obs"].dtype == np.uint8
+    assert batch["obs"].shape == (4, 8, 8, 1)
+    np.testing.assert_array_equal(batch["next_obs"][0], frames[2])

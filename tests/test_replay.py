@@ -1,0 +1,127 @@
+"""DeviceReplay semantics: ring writes, proportional sampling, IS weights,
+priority updates — all under jit, matching reference memory.py behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.replay.device import DeviceReplay
+
+CAP = 128
+
+
+def _example_item(obs_shape=(4,)):
+    return dict(
+        obs=np.zeros(obs_shape, np.uint8),
+        action=np.int32(0),
+        reward=np.float32(0),
+        next_obs=np.zeros(obs_shape, np.uint8),
+        done=np.float32(0),
+    )
+
+
+def _batch(rng, k, obs_shape=(4,)):
+    return dict(
+        obs=rng.integers(0, 255, size=(k,) + obs_shape).astype(np.uint8),
+        action=rng.integers(0, 4, size=k).astype(np.int32),
+        reward=rng.normal(size=k).astype(np.float32),
+        next_obs=rng.integers(0, 255, size=(k,) + obs_shape).astype(np.uint8),
+        done=(rng.random(k) < 0.1).astype(np.float32),
+    )
+
+
+def test_add_ring_semantics():
+    rng = np.random.default_rng(0)
+    rb = DeviceReplay(capacity=CAP, alpha=0.6)
+    state = rb.init(_example_item())
+    add = jax.jit(rb.add)
+
+    b1 = _batch(rng, 100)
+    state = add(state, b1, jnp.ones(100))
+    assert int(state.size) == 100 and int(state.pos) == 100
+
+    b2 = _batch(rng, 50)  # wraps: 28 at tail, 22 at head
+    state = add(state, b2, jnp.ones(50))
+    assert int(state.size) == CAP and int(state.pos) == 22
+
+    stored = np.asarray(state.storage["reward"])
+    np.testing.assert_array_equal(stored[100:], b2["reward"][:28])
+    np.testing.assert_array_equal(stored[:22], b2["reward"][28:])
+    np.testing.assert_array_equal(stored[22:100], b1["reward"][22:])
+
+
+def test_sample_returns_matching_transitions():
+    rng = np.random.default_rng(1)
+    rb = DeviceReplay(capacity=CAP, alpha=0.6)
+    state = rb.init(_example_item())
+    batch = _batch(rng, CAP)
+    state = rb.add(state, batch, jnp.asarray(rng.uniform(0.1, 2.0, CAP)))
+
+    sample = jax.jit(lambda s, k: rb.sample(s, k, 32, 0.4))
+    out, weights, idx = sample(state, jax.random.key(0))
+    idx = np.asarray(idx)
+    np.testing.assert_array_equal(np.asarray(out["action"]), batch["action"][idx])
+    np.testing.assert_array_equal(np.asarray(out["obs"]), batch["obs"][idx])
+    assert weights.shape == (32,) and np.all(np.asarray(weights) > 0)
+    assert np.all(np.asarray(weights) <= 1.0 + 1e-5)  # normalized by max weight
+
+
+def test_is_weights_formula():
+    rng = np.random.default_rng(2)
+    rb = DeviceReplay(capacity=CAP, alpha=0.6)
+    state = rb.init(_example_item())
+    prios = rng.uniform(0.1, 3.0, CAP).astype(np.float32)
+    state = rb.add(state, _batch(rng, CAP), jnp.asarray(prios))
+
+    beta = 0.4
+    idx = jnp.asarray([0, 5, 17, 99])
+    got = np.asarray(rb.is_weights(state, idx, beta))
+
+    p_alpha = np.maximum(prios, 1e-6) ** 0.6
+    p = p_alpha / p_alpha.sum()
+    w = (p * CAP) ** (-beta)
+    want = w[np.asarray(idx)] / w.max()
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_update_priorities_shifts_sampling_mass():
+    rng = np.random.default_rng(3)
+    rb = DeviceReplay(capacity=CAP, alpha=1.0)
+    state = rb.init(_example_item())
+    state = rb.add(state, _batch(rng, CAP), jnp.full(CAP, 0.01))
+    state = rb.update_priorities(state, jnp.asarray([42]), jnp.asarray([100.0]))
+
+    _, _, idx = rb.sample(state, jax.random.key(1), 64, 0.4)
+    frac = (np.asarray(idx) == 42).mean()
+    assert frac > 0.9  # leaf 42 holds ~98.7% of the mass
+    assert float(state.max_priority) == 100.0
+
+
+def test_add_max_priority_uses_running_max():
+    rng = np.random.default_rng(4)
+    rb = DeviceReplay(capacity=CAP, alpha=1.0)
+    state = rb.init(_example_item())
+    state = rb.add(state, _batch(rng, 4), jnp.asarray([1.0, 5.0, 1.0, 1.0]))
+    state = rb.add_max_priority(state, _batch(rng, 2))
+    leaves = np.asarray(state.sum_tree[CAP:CAP + 6])
+    np.testing.assert_allclose(leaves[4:6], [5.0, 5.0], rtol=1e-6)
+
+
+def test_fused_add_sample_update_roundtrip_jit():
+    """The learner-step shape: one jitted fn doing add -> sample -> update."""
+    rng = np.random.default_rng(5)
+    rb = DeviceReplay(capacity=CAP, alpha=0.6)
+    state = rb.init(_example_item())
+
+    @jax.jit
+    def step(state, batch, prios, key):
+        state = rb.add(state, batch, prios)
+        out, w, idx = rb.sample(state, key, 16, 0.4)
+        new_prios = jnp.abs(out["reward"]) + 1e-3
+        state = rb.update_priorities(state, idx, new_prios)
+        return state, w
+
+    for i in range(4):
+        state, w = step(state, _batch(rng, 32), jnp.ones(32),
+                        jax.random.key(i))
+    assert int(state.size) == CAP and np.isfinite(np.asarray(w)).all()

@@ -1,0 +1,92 @@
+"""Segment-tree ops vs. independent numpy oracles (cumsum/searchsorted)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import tree as T
+
+CAP = 64
+
+
+def _random_leaves(rng, cap=CAP, fill=None):
+    n = fill if fill is not None else cap
+    vals = rng.uniform(0.1, 5.0, size=n).astype(np.float32)
+    leaves = np.zeros(cap, np.float32)
+    leaves[:n] = vals
+    return leaves
+
+
+def test_update_sum_matches_numpy():
+    rng = np.random.default_rng(0)
+    tree = T.init_sum_tree(CAP)
+    leaves = _random_leaves(rng)
+    tree = T.update_sum(tree, jnp.arange(CAP), jnp.asarray(leaves))
+    assert np.isclose(float(T.tree_total(tree)), leaves.sum(), rtol=1e-5)
+    # overwrite a random subset; sum follows
+    idx = rng.choice(CAP, size=17, replace=False)
+    new = rng.uniform(0.1, 5.0, size=17).astype(np.float32)
+    tree = T.update_sum(tree, jnp.asarray(idx), jnp.asarray(new))
+    leaves[idx] = new
+    assert np.isclose(float(T.tree_total(tree)), leaves.sum(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(T.get_leaves(tree, jnp.arange(CAP))),
+                               leaves, rtol=1e-6)
+
+
+def test_update_min_matches_numpy():
+    rng = np.random.default_rng(1)
+    tree = T.init_min_tree(CAP)
+    leaves = _random_leaves(rng, fill=40)
+    active = jnp.arange(40)
+    tree = T.update_min(tree, active, jnp.asarray(leaves[:40]))
+    assert np.isclose(float(T.tree_min(tree)), leaves[:40].min(), rtol=1e-6)
+    # lower one leaf, min tracks it; raise it back, min recovers
+    tree2 = T.update_min(tree, jnp.asarray([7]), jnp.asarray([0.01]))
+    assert np.isclose(float(T.tree_min(tree2)), 0.01, rtol=1e-6)
+    tree3 = T.update_min(tree2, jnp.asarray([7]), jnp.asarray([leaves[7]]))
+    assert np.isclose(float(T.tree_min(tree3)), leaves[:40].min(), rtol=1e-6)
+
+
+def test_find_prefixsum_matches_searchsorted():
+    rng = np.random.default_rng(2)
+    leaves = _random_leaves(rng)
+    tree = T.update_sum(T.init_sum_tree(CAP), jnp.arange(CAP), jnp.asarray(leaves))
+    cum = np.cumsum(leaves)
+    u = rng.uniform(0, cum[-1] * 0.999999, size=256).astype(np.float32)
+    got = np.asarray(T.find_prefixsum_idx(tree, jnp.asarray(u)))
+    want = np.searchsorted(cum, u, side="right")
+    # float accumulation order differs between tree and cumsum; allow off-by-one
+    # only where u lands within float eps of a stratum boundary.
+    mismatch = got != want
+    if mismatch.any():
+        near = np.abs(cum[np.minimum(want, CAP - 1)] - u[..., ]) < 1e-3
+        assert np.all(~mismatch | near)
+
+
+def test_stratified_sample_proportional():
+    rng = np.random.default_rng(3)
+    leaves = np.zeros(CAP, np.float32)
+    leaves[:32] = rng.uniform(0.05, 1.0, 32)
+    leaves[5] = 10.0  # dominant priority
+    tree = T.update_sum(T.init_sum_tree(CAP), jnp.arange(CAP), jnp.asarray(leaves))
+
+    @jax.jit
+    def draw(key):
+        return T.stratified_sample(tree, key, 64, jnp.int32(32))
+
+    counts = np.zeros(CAP)
+    n_rounds = 200
+    keys = jax.random.split(jax.random.key(0), n_rounds)
+    for k in keys:
+        idx = np.asarray(draw(k))
+        assert (idx >= 0).all() and (idx < 32).all()
+        np.add.at(counts, idx, 1)
+    emp = counts / counts.sum()
+    expect = leaves / leaves.sum()
+    np.testing.assert_allclose(emp[:32], expect[:32], atol=0.02)
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        T.init_sum_tree(48)
