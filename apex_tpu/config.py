@@ -24,7 +24,10 @@ class ReplayConfig:
     alpha: float = 0.6               # priority exponent
     beta: float = 0.4                # IS-weight exponent (annealed toward 1 by drivers)
     warmup: int = 50_000             # learner gated until this many transitions (arguments.py:47-48)
-    eps: float = 1e-6                # priority floor added to |td|
+    # Clamp floor for priorities entering the sum/min trees (pre-alpha).  The
+    # reference's ADDITIVE 1e-6 on |td| (utils.py:77, memory.py:464) stays
+    # hard-coded in the loss/actor priority calcs, exactly as it does there.
+    eps: float = 1e-6
     # TPU knobs
     device_resident: bool = True     # HBM struct-of-arrays vs. host (C++/numpy) buffer
     frame_pool: bool = False         # dedup frame-pool storage layout for stacked pixels
@@ -65,7 +68,9 @@ class ActorConfig:
     update_interval: int = 400       # env steps between param refresh polls
     eps_base: float = 0.4            # per-actor ladder eps_base^(1 + i/(N-1)*eps_alpha)
     eps_alpha: float = 7.0
-    max_episode_length: int = 50_000
+    # None = the env's own limit; reference Atari deployments use 50_000
+    # (wrapper.py:282-298 TimeLimit via arguments.py max_episode_length)
+    max_episode_length: int | None = None
 
 
 @dataclass(frozen=True)

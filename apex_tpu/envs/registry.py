@@ -68,9 +68,12 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
     env_id = env_id or cfg.env_id
 
     if env_id.startswith("ApexCartPole"):
-        env = toy.CartPoleEnv()
+        env = (toy.CartPoleEnv(max_episode_steps=max_episode_steps)
+               if max_episode_steps is not None else toy.CartPoleEnv())
     elif env_id.startswith("ApexCatch"):
         env = toy.CatchEnv()
+        if max_episode_steps is not None:
+            env = wrappers.TimeLimit(env, max_episode_steps)
         if cfg.frame_stack > 1:
             env = wrappers.FrameStack(env, cfg.frame_stack)
     else:

@@ -51,15 +51,16 @@ def double_dqn_loss(
     target_params: Any,
     batch: dict[str, jax.Array],
     weights: jax.Array,
-    n_steps: int,
-    gamma: float,
 ) -> tuple[jax.Array, TDOutput]:
     """IS-weighted n-step double-DQN Huber loss.
 
-    ``batch['reward']`` is the pre-accumulated n-step return and
-    ``batch['next_obs']`` the state n steps ahead (the actor-side accumulator
-    builds both, mirroring ``memory.py:415-440``), so the discount on the
-    bootstrap term is ``gamma ** n_steps`` (``utils.py:74``).
+    ``batch['reward']`` is the pre-accumulated n-step return,
+    ``batch['next_obs']`` the bootstrap state, and ``batch['discount']`` the
+    per-transition bootstrap coefficient (the actor-side accumulator builds
+    all three, mirroring ``memory.py:415-440``): ``gamma ** n`` for full
+    windows (``utils.py:74``), ``gamma ** k`` for truncated tails, and ``0``
+    at true terminals — replacing the reference's ``gamma ** n * (1 - done)``
+    with truncation-correct bootstrapping.
     """
     obs, next_obs = batch["obs"], batch["next_obs"]
     both = jnp.concatenate([obs, next_obs], axis=0)
@@ -73,8 +74,7 @@ def double_dqn_loss(
     next_q_taken = jnp.take_along_axis(
         tgt_next_q_values, next_actions[:, None], axis=1)[:, 0]
 
-    target = batch["reward"] + (gamma ** n_steps) * next_q_taken * (
-        1.0 - batch["done"])
+    target = batch["reward"] + batch["discount"] * next_q_taken
     td = jax.lax.stop_gradient(target) - q_taken
     td_abs = jnp.abs(td)
 

@@ -27,18 +27,15 @@ global ones.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from apex_tpu.replay.device import DeviceReplay, ReplayState
+from apex_tpu.replay.device import ReplayState
 from apex_tpu.training.learner import LearnerCore
 from apex_tpu.training.state import TrainState
-from apex_tpu.ops.losses import double_dqn_loss
 
 
 def _stack_leading(tree_obj: Any, n: int) -> Any:
@@ -94,35 +91,10 @@ class ShardedLearner:
             rs = core.replay.add(rs, ingest, prios)
             batch, weights, idx = core.replay.sample(
                 rs, key, per_chip_batch, beta)
-
-            def loss_fn(params):
-                return double_dqn_loss(core.apply_fn, params,
-                                       ts.target_params, batch, weights,
-                                       core.n_steps, core.gamma)
-
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                ts.params)
-            grads = jax.lax.pmean(grads, "dp")          # ICI all-reduce
-            loss = jax.lax.pmean(loss, "dp")
-
-            updates, opt_state = core.optimizer.update(grads, ts.opt_state,
-                                                       ts.params)
-            params = optax.apply_updates(ts.params, updates)
-            step = ts.step + 1
-            target_params = jax.lax.cond(
-                step % core.target_update_interval == 0,
-                lambda: jax.tree.map(jnp.copy, params),
-                lambda: ts.target_params)
-
-            rs = core.replay.update_priorities(rs, idx, aux.priorities)
+            new_ts, priorities, metrics = core.update_from_batch(
+                ts, batch, weights, axis_name="dp")
+            rs = core.replay.update_priorities(rs, idx, priorities)
             rs = jax.tree.map(lambda x: x[None], rs)    # restore shard axis
-            metrics = {
-                "loss": loss,
-                "grad_norm": optax.global_norm(grads),
-                "q_mean": jax.lax.pmean(aux.q_taken.mean(), "dp"),
-            }
-            new_ts = TrainState(params=params, target_params=target_params,
-                                opt_state=opt_state, step=step)
             return new_ts, rs, metrics
 
         shard = P("dp")

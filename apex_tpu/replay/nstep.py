@@ -1,20 +1,30 @@
 """Actor-side n-step transition accumulation.
 
 Capability parity with the reference ``BatchStorage`` (``memory.py:393-478``):
-a per-actor sliding window emits ``(s_t, a_t, R_t^(n), s_{t+n}, done)`` with
-the Q-values observed while acting stored alongside, so initial TD priorities
-are computed WITHOUT re-running the network (``memory.py:396-397,451-464``) —
-the key Ape-X trick that keeps priority computation on the actor.
+a per-actor sliding window emits ``(s_t, a_t, R_t^(n), s_{t+n}, discount)``
+with the Q-values observed while acting stored alongside, so initial TD
+priorities are computed WITHOUT re-running the network
+(``memory.py:396-397,451-464``) — the key Ape-X trick that keeps priority
+computation on the actor.
 
-Semantics delta (deliberate correction, not drift): the reference's flush
-accumulates n+1 rewards (``memory.py:418`` passes the deque's n rewards plus
-the current one to ``multi_step_reward``) while the learner bootstraps with
-``gamma ** n`` (``utils.py:74``), double-counting the boundary reward.  Here
-the emitted return is the textbook n-step sum of exactly n rewards,
-``R = sum_{i<n} gamma^i r_{t+i}``, bootstrapped by ``gamma^n max_a Q(s_{t+n})``
-— consistent with the loss in :mod:`apex_tpu.ops.losses`.  On episode end the
-tail of the window flushes with shorter reward sums and ``done=1`` (bootstrap
-masked), matching the reference's flush-on-done (``memory.py:416,432-435``).
+Two deliberate corrections over the reference (not drift):
+
+* The reference's flush accumulates n+1 rewards (``memory.py:418`` passes the
+  deque's n rewards plus the current one to ``multi_step_reward``) while the
+  learner bootstraps with ``gamma ** n`` (``utils.py:74``), double-counting
+  the boundary reward.  Here the emitted return is the textbook n-step sum of
+  exactly k rewards, ``R = sum_{i<k} gamma^i r_{t+i}``.
+* Instead of a ``done`` flag and a fixed ``gamma ** n`` in the loss, each
+  transition carries its own bootstrap ``discount``:
+
+    - full window:            ``discount = gamma ** n``
+    - episode TERMINATED:     tail flushes with ``discount = 0`` (no
+      bootstrap — the env reached a true terminal state)
+    - episode TRUNCATED:      tail flushes with ``discount = gamma ** k``
+      bootstrapping from the final observation — a time-limit cut is NOT a
+      terminal state, and masking it (as ``done = terminated or truncated``
+      would) biases Q-values near the limit low.  This is the
+      gymnasium-API-correct handling the reference predates.
 """
 
 from __future__ import annotations
@@ -36,42 +46,70 @@ class NStepAccumulator:
 
     @staticmethod
     def _empty_out() -> dict[str, list]:
-        return {k: [] for k in ("obs", "action", "reward", "next_obs", "done",
-                                "q0", "qn")}
+        return {k: [] for k in ("obs", "action", "reward", "next_obs",
+                                "discount", "q0", "qn")}
 
     def add(self, obs: Any, action: int, reward: float,
-            q_values: np.ndarray, done: bool) -> None:
-        """Record one env step: ``obs`` is the state acted on, ``reward``/
-        ``done`` the step outcome, ``q_values`` the network output at ``obs``."""
+            q_values: np.ndarray, terminated: bool,
+            truncated: bool = False, final_obs: Any = None) -> None:
+        """Record one env step.
+
+        ``obs`` is the state acted on, ``reward``/``terminated``/``truncated``
+        the step outcome, ``q_values`` the network output at ``obs``.  On a
+        truncated (but not terminated) step, ``final_obs`` must be the
+        observation AFTER the step — the tail bootstraps from it.
+        """
+        if truncated and not terminated and final_obs is None:
+            raise ValueError(
+                "truncated step requires final_obs to bootstrap from")
         self._window.append((obs, action, reward, q_values))
         if len(self._window) == self.n_steps + 1:
-            self._emit(bootstrap=True)
+            self._emit_full()
             self._window.popleft()
-        if done:
-            terminal_obs = self._window[-1][0]
+        if terminated:
+            # True terminal: flush tail with no bootstrap.  next_obs is a
+            # placeholder (the last acted state) — discount=0 masks it.
+            placeholder = self._window[-1][0]
             while self._window:
-                self._emit(bootstrap=False, terminal_obs=terminal_obs)
+                self._emit_tail(next_obs=placeholder, bootstrap=False)
+                self._window.popleft()
+        elif truncated:
+            while self._window:
+                self._emit_tail(next_obs=final_obs, bootstrap=True)
                 self._window.popleft()
 
-    def _emit(self, bootstrap: bool, terminal_obs: Any = None) -> None:
-        """Emit the oldest windowed transition."""
+    def _emit_full(self) -> None:
+        """Emit the oldest transition with a full n-step window."""
         w = self._window
-        obs0, action0, _, q0 = w[0]
-        ret = 0.0
-        for i in range(len(w) if not bootstrap else self.n_steps):
-            ret += (self.gamma ** i) * w[i][2]
-        if bootstrap:
-            next_obs, qn = w[self.n_steps][0], w[self.n_steps][3]
-            done = 0.0
-        else:
-            next_obs, qn = terminal_obs, w[-1][3]
-            done = 1.0
+        n = self.n_steps
+        ret = sum((self.gamma ** i) * w[i][2] for i in range(n))
+        self._push(w[0], ret, next_obs=w[n][0], discount=self.gamma ** n,
+                   qn=w[n][3])
+
+    def _emit_tail(self, next_obs: Any, bootstrap: bool) -> None:
+        """Emit the oldest windowed transition at episode end (k < n rewards).
+
+        For truncation the bootstrap Q estimate ``qn`` is the Q at the LAST
+        acted state (one step before ``final_obs``) — the closest estimate
+        available without re-running the network; it only seeds the initial
+        priority, which the learner corrects on first sample.
+        """
+        w = self._window
+        k = len(w)
+        ret = sum((self.gamma ** i) * w[i][2] for i in range(k))
+        discount = (self.gamma ** k) if bootstrap else 0.0
+        self._push(w[0], ret, next_obs=next_obs, discount=discount,
+                   qn=w[-1][3])
+
+    def _push(self, head: tuple, ret: float, next_obs: Any, discount: float,
+              qn: np.ndarray) -> None:
+        obs0, action0, _, q0 = head
         o = self._out
         o["obs"].append(obs0)
         o["action"].append(action0)
         o["reward"].append(np.float32(ret))
         o["next_obs"].append(next_obs)
-        o["done"].append(np.float32(done))
+        o["discount"].append(np.float32(discount))
         o["q0"].append(q0)
         o["qn"].append(qn)
 
@@ -83,11 +121,11 @@ class NStepAccumulator:
         o = self._out
         actions = np.asarray(o["action"])
         rewards = np.asarray(o["reward"], np.float32)
-        dones = np.asarray(o["done"], np.float32)
+        discounts = np.asarray(o["discount"], np.float32)
         q0 = np.stack(o["q0"])
         qn = np.stack(o["qn"])
         q_taken = q0[np.arange(len(q0)), actions]
-        target = rewards + (self.gamma ** self.n_steps) * qn.max(1) * (1 - dones)
+        target = rewards + discounts * qn.max(1)
         return np.abs(target - q_taken).astype(np.float32) + 1e-6
 
     def make_batch(self) -> tuple[dict[str, np.ndarray], np.ndarray]:
@@ -101,7 +139,7 @@ class NStepAccumulator:
             action=np.asarray(o["action"], np.int32),
             reward=np.asarray(o["reward"], np.float32),
             next_obs=np.stack([np.asarray(x) for x in o["next_obs"]]),
-            done=np.asarray(o["done"], np.float32),
+            discount=np.asarray(o["discount"], np.float32),
         )
         self._out = self._empty_out()
         return batch, prios

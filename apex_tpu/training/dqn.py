@@ -64,7 +64,8 @@ class DQNTrainer:
         self.cfg = config or ApexConfig()
         self.key = set_global_seeds(self.cfg.env.seed)
         self.env = make_env(self.cfg.env.env_id, self.cfg.env,
-                            seed=self.cfg.env.seed)
+                            seed=self.cfg.env.seed,
+                            max_episode_steps=self.cfg.actor.max_episode_length)
         obs_shape = self.env.observation_space.shape
         self.model = DuelingDQN(
             num_actions=num_actions(self.env),
@@ -80,8 +81,10 @@ class DQNTrainer:
             learner_lib.build_learner(
                 self.model, self.cfg.replay.capacity, example_obs, init_key,
                 alpha=self.cfg.replay.alpha, batch_size=lc.batch_size,
-                n_steps=lc.n_steps, gamma=lc.gamma, lr=lc.lr,
-                max_grad_norm=lc.max_grad_norm,
+                lr=lc.lr, max_grad_norm=lc.max_grad_norm,
+                rmsprop_decay=lc.rmsprop_decay, rmsprop_eps=lc.rmsprop_eps,
+                rmsprop_centered=lc.rmsprop_centered,
+                replay_eps=self.cfg.replay.eps,
                 target_update_interval=lc.target_update_interval)
         self._train_step = self.core.jit_train_step()
         self._ingest = self.core.jit_ingest()
@@ -144,7 +147,10 @@ class DQNTrainer:
             next_obs, reward, terminated, truncated, _ = self.env.step(action)
             done = terminated or truncated
             self.accumulator.add(obs_np, action, float(reward), q_np,
-                                 bool(done))
+                                 terminated=bool(terminated),
+                                 truncated=bool(truncated),
+                                 final_obs=(np.asarray(next_obs)
+                                            if truncated else None))
             obs = next_obs
             episode_reward += float(reward)
             episode_len += 1

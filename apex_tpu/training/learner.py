@@ -16,8 +16,7 @@ compiled into the same program instead of a host-side branch.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Callable
 
 import jax
@@ -40,25 +39,31 @@ class LearnerCore:
     replay: DeviceReplay
     optimizer: optax.GradientTransformation
     batch_size: int = 512
-    n_steps: int = 3
-    gamma: float = 0.99
     target_update_interval: int = 2500
 
     # -- step functions ----------------------------------------------------
 
-    def train_step(self, train_state: TrainState, replay_state: ReplayState,
-                   key: jax.Array, beta: jax.Array):
-        """Sample -> loss -> update -> priorities.  Pure; jit via make_*."""
-        batch, weights, idx = self.replay.sample(
-            replay_state, key, self.batch_size, beta)
+    def update_from_batch(self, train_state: TrainState, batch: Any,
+                          weights: jax.Array, axis_name: str | None = None):
+        """The update body shared by every learner variant: loss/grads ->
+        (optional cross-chip pmean) -> clip+RMSprop -> periodic target sync.
+
+        ``axis_name`` is the mesh axis to all-reduce gradients/metrics over
+        (the sharded learner passes ``"dp"``); ``None`` = single chip.  One
+        body, one numerical contract (SURVEY.md §3.3).
+
+        Returns ``(train_state, priorities, metrics)``.
+        """
 
         def loss_fn(params):
             return double_dqn_loss(self.apply_fn, params,
-                                   train_state.target_params, batch, weights,
-                                   self.n_steps, self.gamma)
+                                   train_state.target_params, batch, weights)
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             train_state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)     # ICI all-reduce
+            loss = jax.lax.pmean(loss, axis_name)
         updates, opt_state = self.optimizer.update(
             grads, train_state.opt_state, train_state.params)
         params = optax.apply_updates(train_state.params, updates)
@@ -69,16 +74,30 @@ class LearnerCore:
             lambda: jax.tree.map(jnp.copy, params),
             lambda: train_state.target_params)
 
-        replay_state = self.replay.update_priorities(replay_state, idx,
-                                                     aux.priorities)
+        q_mean = aux.q_taken.mean()
+        td_mean = aux.td_abs.mean()
+        if axis_name is not None:
+            q_mean = jax.lax.pmean(q_mean, axis_name)
+            td_mean = jax.lax.pmean(td_mean, axis_name)
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),
-            "q_mean": aux.q_taken.mean(),
-            "td_mean": aux.td_abs.mean(),
+            "q_mean": q_mean,
+            "td_mean": td_mean,
         }
         train_state = TrainState(params=params, target_params=target_params,
                                  opt_state=opt_state, step=step)
+        return train_state, aux.priorities, metrics
+
+    def train_step(self, train_state: TrainState, replay_state: ReplayState,
+                   key: jax.Array, beta: jax.Array):
+        """Sample -> loss -> update -> priorities.  Pure; jit via make_*."""
+        batch, weights, idx = self.replay.sample(
+            replay_state, key, self.batch_size, beta)
+        train_state, priorities, metrics = self.update_from_batch(
+            train_state, batch, weights)
+        replay_state = self.replay.update_priorities(replay_state, idx,
+                                                     priorities)
         return train_state, replay_state, metrics
 
     def ingest(self, replay_state: ReplayState, batch: Any,
@@ -106,14 +125,18 @@ class LearnerCore:
 
 def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
                   *, alpha: float = 0.6, batch_size: int = 512,
-                  n_steps: int = 3, gamma: float = 0.99,
                   lr: float = 6.25e-5, max_grad_norm: float = 40.0,
+                  rmsprop_decay: float = 0.95, rmsprop_eps: float = 1.5e-7,
+                  rmsprop_centered: bool = True, replay_eps: float = 1e-6,
                   target_update_interval: int = 2500,
                   obs_dtype=None) -> tuple[LearnerCore, TrainState, ReplayState]:
     """Convenience constructor used by drivers and benches."""
-    optimizer = make_optimizer(lr=lr, max_grad_norm=max_grad_norm)
+    optimizer = make_optimizer(lr=lr, decay=rmsprop_decay, eps=rmsprop_eps,
+                               centered=rmsprop_centered,
+                               max_grad_norm=max_grad_norm)
     train_state = create_train_state(model, optimizer, key, example_obs)
-    replay = DeviceReplay(capacity=replay_capacity, alpha=alpha)
+    replay = DeviceReplay(capacity=replay_capacity, alpha=alpha,
+                          eps=replay_eps)
     example_item = dict(
         obs=jnp.zeros(example_obs.shape[1:],
                       obs_dtype or example_obs.dtype),
@@ -121,11 +144,10 @@ def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
         reward=jnp.float32(0),
         next_obs=jnp.zeros(example_obs.shape[1:],
                            obs_dtype or example_obs.dtype),
-        done=jnp.float32(0),
+        discount=jnp.float32(0),
     )
     replay_state = replay.init(example_item)
     core = LearnerCore(apply_fn=model.apply, replay=replay,
                        optimizer=optimizer, batch_size=batch_size,
-                       n_steps=n_steps, gamma=gamma,
                        target_update_interval=target_update_interval)
     return core, train_state, replay_state

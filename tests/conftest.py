@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env vars take effect)
 
+# The image's sitecustomize imports jax at interpreter startup (before this
+# conftest runs), so JAX_PLATFORMS=axon from the environment is already baked
+# into the config default.  jax.config.update still wins as long as no backend
+# has been initialized, which is the case at collection time.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

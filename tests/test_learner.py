@@ -17,7 +17,7 @@ def _setup(key, batch_size=16, capacity=256, target_interval=5):
     example = jnp.zeros((1, 6), jnp.float32)
     core, ts, rs = build_learner(
         model, capacity, example, key, batch_size=batch_size,
-        n_steps=3, target_update_interval=target_interval)
+        target_update_interval=target_interval)
     return core, ts, rs
 
 
@@ -28,7 +28,7 @@ def _fill(core, rs, n, seed=0):
         action=rng.integers(0, 3, n).astype(np.int32),
         reward=rng.normal(size=n).astype(np.float32),
         next_obs=rng.normal(size=(n, 6)).astype(np.float32),
-        done=np.zeros(n, np.float32))
+        discount=np.full(n, 0.99 ** 3, np.float32))
     return core.jit_ingest()(rs, batch, jnp.ones(n))
 
 
@@ -77,7 +77,7 @@ def test_fused_step_ingests_and_trains(key):
         action=rng.integers(0, 3, 16).astype(np.int32),
         reward=rng.normal(size=16).astype(np.float32),
         next_obs=rng.normal(size=(16, 6)).astype(np.float32),
-        done=np.zeros(16, np.float32))
+        discount=np.full(16, 0.99 ** 3, np.float32))
     ts2, rs2, metrics = fused(ts, rs, batch, jnp.ones(16),
                               jax.random.key(2), jnp.float32(0.4))
     assert int(rs2.size) == 48 and int(ts2.step) == 1

@@ -8,13 +8,14 @@ from apex_tpu.ops.losses import (double_dqn_loss, huber, make_optimizer,
                                  mixed_max_priorities)
 
 
-def _numpy_oracle(q, next_q, tgt_next_q, actions, rewards, dones, weights,
-                  n_steps, gamma):
-    """Independent re-derivation of utils.py:64-81 semantics in numpy."""
+def _numpy_oracle(q, next_q, tgt_next_q, actions, rewards, discounts,
+                  weights):
+    """Independent re-derivation of utils.py:64-81 semantics in numpy
+    (with the per-transition discount replacing gamma**n * (1 - done))."""
     q_taken = q[np.arange(len(q)), actions]
     next_act = next_q.argmax(1)
     boot = tgt_next_q[np.arange(len(q)), next_act]
-    target = rewards + gamma ** n_steps * boot * (1 - dones)
+    target = rewards + discounts * boot
     td = np.abs(target - q_taken)
     prios = 0.9 * td.max() + 0.1 * td + 1e-6
     l = np.where(td < 1, 0.5 * td ** 2, td - 0.5)
@@ -34,30 +35,31 @@ class _TableModel:
 
 def test_double_dqn_loss_matches_oracle():
     rng = np.random.default_rng(0)
-    B, D, A, n, gamma = 32, 6, 4, 3, 0.99
+    B, D, A, gamma = 32, 6, 4, 0.99
     m = _TableModel(A, D, 1)
     w_online = m.w
     w_target = rng.normal(size=(D, A)).astype(np.float32)
 
+    # mix of full-window (gamma^3), truncated-tail (gamma^1) and terminal (0)
+    discounts = rng.choice([gamma ** 3, gamma, 0.0], B).astype(np.float32)
     batch = dict(
         obs=rng.normal(size=(B, D)).astype(np.float32),
         next_obs=rng.normal(size=(B, D)).astype(np.float32),
         action=rng.integers(0, A, B).astype(np.int32),
         reward=rng.normal(size=B).astype(np.float32),
-        done=(rng.random(B) < 0.2).astype(np.float32),
+        discount=discounts,
     )
     weights = rng.uniform(0.2, 1.0, B).astype(np.float32)
 
     loss, aux = jax.jit(
-        lambda p, tp, b, w: double_dqn_loss(m.apply, p, tp, b, w, n, gamma)
+        lambda p, tp, b, w: double_dqn_loss(m.apply, p, tp, b, w)
     )(w_online, w_target, batch, jnp.asarray(weights))
 
     q = batch["obs"] @ w_online
     nq = batch["next_obs"] @ w_online
     tnq = batch["next_obs"] @ w_target
     want_loss, want_td, want_prios = _numpy_oracle(
-        q, nq, tnq, batch["action"], batch["reward"], batch["done"], weights,
-        n, gamma)
+        q, nq, tnq, batch["action"], batch["reward"], discounts, weights)
 
     np.testing.assert_allclose(float(loss), want_loss, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(aux.td_abs), want_td, rtol=1e-4)
@@ -94,11 +96,11 @@ def test_gradient_flows_only_through_online_q(key):
     batch = dict(
         obs=np.ones((8, 4), np.float32), next_obs=np.ones((8, 4), np.float32),
         action=np.zeros(8, np.int32), reward=np.ones(8, np.float32),
-        done=np.zeros(8, np.float32))
+        discount=np.full(8, 0.99 ** 3, np.float32))
     w = jnp.ones(8)
 
     def loss_wrt_target(tp):
-        return double_dqn_loss(m.apply, m.w, tp, batch, w, 3, 0.99)[0]
+        return double_dqn_loss(m.apply, m.w, tp, batch, w)[0]
 
     g = jax.grad(loss_wrt_target)(jnp.asarray(m.w))
     np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-7)

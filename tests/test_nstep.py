@@ -1,17 +1,22 @@
 """NStepAccumulator vs. a brute-force trajectory oracle."""
 
 import numpy as np
+import pytest
 
 from apex_tpu.replay.nstep import NStepAccumulator
 
 
-def _run_episode(acc, rewards, gamma, n):
+def _run_episode(acc, rewards, gamma, n, end="terminated"):
     """Feed a synthetic episode; obs at step t is t, q_values are fixed."""
     T = len(rewards)
     for t in range(T):
         q = np.asarray([0.5, 1.5], np.float32)  # max=1.5, action 0 -> q=0.5
+        last = t == T - 1
         acc.add(obs=np.float32(t), action=0, reward=rewards[t],
-                q_values=q, done=(t == T - 1))
+                q_values=q, terminated=(last and end == "terminated"),
+                truncated=(last and end == "truncated"),
+                final_obs=np.float32(T) if (last and end == "truncated")
+                else None)
 
 
 def test_nstep_returns_match_bruteforce():
@@ -26,13 +31,44 @@ def test_nstep_returns_match_bruteforce():
     for t in range(3):
         want = sum(gamma ** i * rewards[t + i] for i in range(n))
         np.testing.assert_allclose(batch["reward"][t], want, rtol=1e-6)
-        assert batch["done"][t] == 0.0
+        np.testing.assert_allclose(batch["discount"][t], gamma ** n, rtol=1e-6)
         assert batch["obs"][t] == t and batch["next_obs"][t] == t + n
-    # terminal flush: t=3,4,5 get truncated sums and done=1
+    # terminal flush: t=3,4,5 get truncated sums and discount=0
     for t in range(3, 6):
         want = sum(gamma ** i * rewards[t + i] for i in range(6 - t))
         np.testing.assert_allclose(batch["reward"][t], want, rtol=1e-6)
-        assert batch["done"][t] == 1.0
+        assert batch["discount"][t] == 0.0
+
+
+def test_truncation_bootstraps_from_final_obs():
+    """A time-limit cut is not a terminal: the tail must keep a gamma**k
+    bootstrap from the final observation instead of discount=0."""
+    n, gamma = 3, 0.9
+    rewards = [1.0, 2.0, 3.0, 4.0, 5.0]
+    acc = NStepAccumulator(n, gamma)
+    _run_episode(acc, rewards, gamma, n, end="truncated")
+    batch, prios = acc.make_batch()
+
+    assert len(batch["obs"]) == 5
+    # t=0,1: full windows
+    for t in range(2):
+        np.testing.assert_allclose(batch["discount"][t], gamma ** n, rtol=1e-6)
+    # tail t=2,3,4: k = 3,2,1 remaining rewards, bootstrap from final_obs=5
+    for t, k in [(2, 3), (3, 2), (4, 1)]:
+        want_ret = sum(gamma ** i * rewards[t + i] for i in range(k))
+        np.testing.assert_allclose(batch["reward"][t], want_ret, rtol=1e-6)
+        np.testing.assert_allclose(batch["discount"][t], gamma ** k, rtol=1e-6)
+        assert batch["next_obs"][t] == 5.0
+    # priorities use the bootstrap: target = R + gamma**k * max_q(=1.5)
+    want_p = abs(rewards[4] + gamma * 1.5 - 0.5) + 1e-6
+    np.testing.assert_allclose(prios[4], want_p, rtol=1e-5)
+
+
+def test_truncated_requires_final_obs():
+    acc = NStepAccumulator(2, 0.99)
+    with pytest.raises(ValueError):
+        acc.add(np.float32(0), 0, 1.0, np.zeros(2, np.float32),
+                terminated=False, truncated=True)
 
 
 def test_priorities_match_manual_td():
@@ -57,7 +93,7 @@ def test_multi_episode_no_window_leak():
     assert len(batch["obs"]) == 7
     # first episode transitions must not see episode-2 rewards
     np.testing.assert_allclose(batch["reward"][0], 1.0 + 0.99 * 1.0, rtol=1e-6)
-    assert batch["done"][0] == 1.0 and batch["done"][1] == 1.0
+    assert batch["discount"][0] == 0.0 and batch["discount"][1] == 0.0
 
 
 def test_uint8_image_obs_roundtrip():
@@ -65,7 +101,8 @@ def test_uint8_image_obs_roundtrip():
     frames = [np.full((8, 8, 1), t, np.uint8) for t in range(4)]
     for t in range(4):
         acc.add(frames[t], action=1, reward=1.0,
-                q_values=np.asarray([0.0, 1.0], np.float32), done=(t == 3))
+                q_values=np.asarray([0.0, 1.0], np.float32),
+                terminated=(t == 3))
     batch, _ = acc.make_batch()
     assert batch["obs"].dtype == np.uint8
     assert batch["obs"].shape == (4, 8, 8, 1)

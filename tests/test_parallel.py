@@ -17,7 +17,7 @@ def _mk_batch(rng, k, dim=6, n_act=3):
         action=rng.integers(0, n_act, k).astype(np.int32),
         reward=rng.normal(size=k).astype(np.float32),
         next_obs=rng.normal(size=(k, dim)).astype(np.float32),
-        done=np.zeros(k, np.float32))
+        discount=np.full(k, 0.99 ** 3, np.float32))
 
 
 def test_mesh_construction():
@@ -36,7 +36,7 @@ def test_sharded_fused_step_runs_and_replicates(key):
 
     example_item = dict(obs=jnp.zeros(6), action=jnp.int32(0),
                         reward=jnp.float32(0), next_obs=jnp.zeros(6),
-                        done=jnp.float32(0))
+                        discount=jnp.float32(0))
     rs = sl.init_replay(example_item)
     assert rs.sum_tree.shape == (8, 2 * 256)
     ts = sl.replicate_train_state(ts)

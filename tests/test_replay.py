@@ -16,7 +16,7 @@ def _example_item(obs_shape=(4,)):
         action=np.int32(0),
         reward=np.float32(0),
         next_obs=np.zeros(obs_shape, np.uint8),
-        done=np.float32(0),
+        discount=np.float32(0),
     )
 
 
@@ -26,7 +26,8 @@ def _batch(rng, k, obs_shape=(4,)):
         action=rng.integers(0, 4, size=k).astype(np.int32),
         reward=rng.normal(size=k).astype(np.float32),
         next_obs=rng.integers(0, 255, size=(k,) + obs_shape).astype(np.uint8),
-        done=(rng.random(k) < 0.1).astype(np.float32),
+        discount=np.where(rng.random(k) < 0.1, 0.0, 0.99 ** 3
+                          ).astype(np.float32),
     )
 
 
