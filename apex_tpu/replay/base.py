@@ -1,0 +1,47 @@
+"""PER math shared by every replay layout.
+
+Both :class:`apex_tpu.replay.device.DeviceReplay` (stacked storage) and
+:class:`apex_tpu.replay.frame_pool.FramePoolReplay` (frame-pool storage)
+keep identical ``sum_tree``/``min_tree``/``size``/``max_priority`` fields in
+their state; the priority-update and importance-weight math over those
+fields lives here once so the two layouts cannot diverge semantically
+(reference: ``memory.py:252-320``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import tree as tree_ops
+
+
+class PERMethods:
+    """Mixin over frozen replay specs with ``alpha``/``eps`` fields and
+    states carrying ``sum_tree``/``min_tree``/``size``/``max_priority``."""
+
+    def update_priorities(self, state, idx: jax.Array,
+                          priorities: jax.Array):
+        """Store ``priority ** alpha`` and track the running max
+        (``memory.py:300-320``).  Duplicate ``idx`` entries must carry equal
+        values (they do on every call path: duplicates share batch rows)."""
+        p_alpha = self._to_tree_priority(priorities)
+        sum_tree, min_tree = tree_ops.update_both(
+            state.sum_tree, state.min_tree, idx, p_alpha)
+        return state.replace(
+            sum_tree=sum_tree, min_tree=min_tree,
+            max_priority=jnp.maximum(state.max_priority, priorities.max()))
+
+    def is_weights(self, state, idx: jax.Array,
+                   beta: float | jax.Array) -> jax.Array:
+        """IS weights normalized by the max weight from the min-priority
+        leaf (``memory.py:252-298``)."""
+        total = tree_ops.tree_total(state.sum_tree)
+        size = state.size.astype(jnp.float32)
+        p_min = tree_ops.tree_min(state.min_tree) / total
+        max_weight = (p_min * size) ** (-beta)
+        p_sample = tree_ops.get_leaves(state.sum_tree, idx) / total
+        return ((p_sample * size) ** (-beta) / max_weight).astype(jnp.float32)
+
+    def _to_tree_priority(self, priorities: jax.Array) -> jax.Array:
+        p = jnp.maximum(priorities.astype(jnp.float32), self.eps)
+        return p ** self.alpha

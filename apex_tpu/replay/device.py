@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from apex_tpu.ops import tree as tree_ops
+from apex_tpu.replay.base import PERMethods
 
 
 @struct.dataclass
@@ -51,7 +52,7 @@ class ReplayState:
 
 
 @dataclass(frozen=True)
-class DeviceReplay:
+class DeviceReplay(PERMethods):
     """Static spec + pure methods.  Hashable, so it can close over jits."""
 
     capacity: int
@@ -103,14 +104,7 @@ class DeviceReplay:
         prios = jnp.full((k,), state.max_priority, dtype=jnp.float32)
         return self.add(state, batch, prios)
 
-    def update_priorities(self, state: ReplayState, idx: jax.Array,
-                          priorities: jax.Array) -> ReplayState:
-        p_alpha = self._to_tree_priority(priorities)
-        sum_tree, min_tree = tree_ops.update_both(
-            state.sum_tree, state.min_tree, idx, p_alpha)
-        return state.replace(
-            sum_tree=sum_tree, min_tree=min_tree,
-            max_priority=jnp.maximum(state.max_priority, priorities.max()))
+    # update_priorities / is_weights / _to_tree_priority: PERMethods.
 
     # -- sampling ----------------------------------------------------------
 
@@ -122,18 +116,3 @@ class DeviceReplay:
         batch = jax.tree.map(lambda s: s[idx], state.storage)
         weights = self.is_weights(state, idx, beta)
         return batch, weights, idx
-
-    def is_weights(self, state: ReplayState, idx: jax.Array,
-                   beta: float | jax.Array) -> jax.Array:
-        total = tree_ops.tree_total(state.sum_tree)
-        size = state.size.astype(jnp.float32)
-        p_min = tree_ops.tree_min(state.min_tree) / total
-        max_weight = (p_min * size) ** (-beta)
-        p_sample = tree_ops.get_leaves(state.sum_tree, idx) / total
-        return ((p_sample * size) ** (-beta) / max_weight).astype(jnp.float32)
-
-    # -- helpers -----------------------------------------------------------
-
-    def _to_tree_priority(self, priorities: jax.Array) -> jax.Array:
-        p = jnp.maximum(priorities.astype(jnp.float32), self.eps)
-        return p ** self.alpha

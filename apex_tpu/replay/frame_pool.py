@@ -1,0 +1,224 @@
+"""Frame-pool prioritized replay: stacks reconstructed on device at sample time.
+
+The memory problem this solves: storing stacked observations materializes
+every 84x84 frame ``2 * frame_stack`` times (obs + next_obs of neighboring
+transitions share stack-1 frames).  The reference dedups with host-side
+LazyFrames (``wrapper.py:218-252``) and still needs a 128GB replay host for
+2e6 transitions.  On TPU the replay lives in HBM (16GB/chip), so the dedup
+must move into the storage layout itself:
+
+* a frame ring ``u8[F, D]`` stores every frame ONCE, flattened to D bytes so
+  XLA's (8,128) tiling pads <2% instead of padding 84 -> 128;
+* transitions store ``int32`` frame indices (``obs_ids``/``next_ids`` of
+  shape ``[C, S]``); sampling gathers ``B*S`` rows and reassembles the
+  NHWC stack (oldest first, matching :class:`apex_tpu.envs.wrappers.FrameStack`)
+  inside the same fused XLA step.
+
+Net: ~8x more capacity per chip than stacked storage (one frame per step vs
+2S frames per transition).
+
+Ingest contract (chunks built by
+:class:`apex_tpu.replay.frame_chunks.FrameChunkBuilder`): every chunk is
+SELF-CONTAINED — it ships all frames its transitions reference, with
+chunk-relative refs in ``[0, Kf)``.  Chunks from many actors can interleave
+freely.  Fixed shapes with variable fill: a chunk carries ``n_frames <=
+Kf`` real frames and ``n_trans <= K`` real transitions (``n_trans >= 1``,
+``n_frames >= 1`` — the builder never ships empty chunks), and the ring
+cursors advance by the REAL counts.  Pad rows (which the builder fills by
+REPEATING the last real row, priorities included) are written to the SAME
+ring slot as that last real row: a scatter with duplicate indices all
+carrying identical values is deterministic, so padding writes nothing new
+and can never clobber older live entries.
+
+Liveness: a transition's frames can be overwritten before the transition
+itself when frames arrive faster than ~(frame_capacity/capacity) per
+transition — e.g. bursts of length-1 episodes plus chunk-boundary carry.
+Rather than relying on a static sizing invariant, staleness is DETECTED at
+sample time: each transition records the frame-cursor epoch of its chunk,
+and sampled transitions whose epoch has fallen out of the frame ring's
+horizon are redirected to the newest (always-valid) slot.  All redirected
+rows share that slot's data, so their TD errors — and the duplicate
+priority write-back — are identical, keeping the tree deterministic.  With
+the default ``frame_capacity = 2 * capacity`` redirection is a measure-zero
+event for normal workloads; it is a graceful degradation, never silent
+corruption.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from apex_tpu.ops import tree as tree_ops
+from apex_tpu.replay.base import PERMethods
+
+
+@struct.dataclass
+class FramePoolState:
+    """Donated-buffer state of one frame-pool shard."""
+
+    frames: jax.Array       # u8[F, D] — flattened frame ring
+    action: jax.Array       # i32[C]
+    reward: jax.Array       # f32[C] — pre-accumulated n-step return
+    discount: jax.Array     # f32[C] — bootstrap coefficient (0 at terminal)
+    obs_ids: jax.Array      # i32[C, S] — frame-ring rows, oldest first
+    next_ids: jax.Array     # i32[C, S]
+    frame_epoch: jax.Array  # i32[C] — frame-cursor epoch at ingest (for
+                            #   staleness detection; i32 wraparound-safe
+                            #   because only differences < 2^31 matter)
+    sum_tree: jax.Array     # f32[2C]
+    min_tree: jax.Array     # f32[2C]
+    pos: jax.Array          # i32 — next transition write index
+    f_epoch: jax.Array      # i32 — total frames ever written (frame cursor
+                            #   is f_epoch % F)
+    size: jax.Array         # i32 — live transition count
+    max_priority: jax.Array  # f32
+
+
+@dataclass(frozen=True)
+class FramePoolReplay(PERMethods):
+    """Static spec + pure methods (hashable; closes over jits).
+
+    ``frame_shape`` is one frame's (H, W, c); sampled observations are
+    ``(B, H, W, S*c)`` uint8, oldest frame first on the channel axis.
+    """
+
+    capacity: int
+    frame_shape: tuple[int, ...] = (84, 84, 1)
+    frame_stack: int = 4
+    frame_capacity: int | None = None
+    alpha: float = 0.6
+    eps: float = 1e-6
+
+    def __post_init__(self):
+        tree_ops._check_capacity(self.capacity)
+        tree_ops._check_capacity(self.f_capacity)
+
+    @property
+    def f_capacity(self) -> int:
+        return (self.frame_capacity if self.frame_capacity is not None
+                else 2 * self.capacity)
+
+    @property
+    def frame_dim(self) -> int:
+        return math.prod(self.frame_shape)
+
+    # -- construction ------------------------------------------------------
+
+    def init(self, example_item=None) -> FramePoolState:
+        """``example_item`` is accepted and ignored for interface parity
+        with :meth:`DeviceReplay.init` (shapes come from the spec)."""
+        c, s = self.capacity, self.frame_stack
+        return FramePoolState(
+            frames=jnp.zeros((self.f_capacity, self.frame_dim), jnp.uint8),
+            action=jnp.zeros(c, jnp.int32),
+            reward=jnp.zeros(c, jnp.float32),
+            discount=jnp.zeros(c, jnp.float32),
+            obs_ids=jnp.zeros((c, s), jnp.int32),
+            next_ids=jnp.zeros((c, s), jnp.int32),
+            frame_epoch=jnp.full(c, jnp.int32(-(2 ** 30))),  # born stale
+            sum_tree=tree_ops.init_sum_tree(c),
+            min_tree=tree_ops.init_min_tree(c),
+            pos=jnp.int32(0),
+            f_epoch=jnp.int32(0),
+            size=jnp.int32(0),
+            max_priority=jnp.float32(1.0),
+        )
+
+    # -- mutation (pure) ---------------------------------------------------
+
+    def add(self, state: FramePoolState, chunk: dict,
+            priorities: jax.Array) -> FramePoolState:
+        """Ingest one self-contained chunk (see module docstring).
+
+        ``chunk`` keys: ``frames`` u8[Kf, D], ``n_frames`` i32, ``n_trans``
+        i32, ``action``/``reward``/``discount`` [K], ``obs_ref``/``next_ref``
+        i32[K, S] (chunk-relative).  ``priorities`` f32[K].
+
+        Pad rows (>= n_frames / n_trans, repeats of the last real row) are
+        redirected onto the last real row's slot — identical duplicate
+        writes, so nothing old is clobbered.
+        """
+        kf = chunk["frames"].shape[0]
+        k = priorities.shape[0]
+        f, c = self.f_capacity, self.capacity
+        fpos = state.f_epoch % f
+
+        frow = jnp.minimum(jnp.arange(kf, dtype=jnp.int32),
+                           chunk["n_frames"] - 1)
+        fidx = (fpos + frow) % f
+        frames = state.frames.at[fidx].set(chunk["frames"])
+
+        trow = jnp.minimum(jnp.arange(k, dtype=jnp.int32),
+                           chunk["n_trans"] - 1)
+        tidx = (state.pos + trow) % c
+        obs_ids = (fpos + chunk["obs_ref"]) % f
+        next_ids = (fpos + chunk["next_ref"]) % f
+
+        p_alpha = self._to_tree_priority(priorities)
+        sum_tree, min_tree = tree_ops.update_both(
+            state.sum_tree, state.min_tree, tidx, p_alpha)
+
+        return state.replace(
+            frames=frames,
+            action=state.action.at[tidx].set(chunk["action"].astype(jnp.int32)),
+            reward=state.reward.at[tidx].set(
+                chunk["reward"].astype(jnp.float32)),
+            discount=state.discount.at[tidx].set(
+                chunk["discount"].astype(jnp.float32)),
+            obs_ids=state.obs_ids.at[tidx].set(obs_ids),
+            next_ids=state.next_ids.at[tidx].set(next_ids),
+            frame_epoch=state.frame_epoch.at[tidx].set(state.f_epoch),
+            sum_tree=sum_tree, min_tree=min_tree,
+            pos=(state.pos + chunk["n_trans"]) % c,
+            f_epoch=state.f_epoch + chunk["n_frames"],
+            size=jnp.minimum(state.size + chunk["n_trans"], c),
+            max_priority=jnp.maximum(state.max_priority, priorities.max()),
+        )
+
+    # update_priorities / is_weights / _to_tree_priority: PERMethods.
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, state: FramePoolState, key: jax.Array, batch_size: int,
+               beta: float | jax.Array):
+        """Stratified PER sample; returns ``(batch, weights, idx)`` with
+        stacks gathered from the frame ring.
+
+        Staleness guard (module docstring): transitions whose chunk's frames
+        have aged out of the ring are redirected to the newest slot.  i32
+        wraparound in the epoch difference is safe for ages < 2^31.
+        """
+        idx = tree_ops.stratified_sample(state.sum_tree, key, batch_size,
+                                         state.size)
+        age = state.f_epoch - state.frame_epoch[idx]
+        newest = (state.pos - 1) % self.capacity
+        idx = jnp.where(age <= self.f_capacity, idx, newest)
+        batch = dict(
+            obs=self._gather_stacks(state, state.obs_ids[idx]),
+            action=state.action[idx],
+            reward=state.reward[idx],
+            next_obs=self._gather_stacks(state, state.next_ids[idx]),
+            discount=state.discount[idx],
+        )
+        weights = self.is_weights(state, idx, beta)
+        return batch, weights, idx
+
+    def _gather_stacks(self, state: FramePoolState,
+                       ids: jax.Array) -> jax.Array:
+        """(B, S) frame-ring rows -> (B, H, W, S*c) uint8, oldest first."""
+        b, s = ids.shape
+        h, w, ch = self.frame_shape
+        rows = state.frames[ids.reshape(-1)]            # (B*S, D)
+        rows = rows.reshape(b, s, h, w, ch)
+        return jnp.moveaxis(rows, 1, 3).reshape(b, h, w, s * ch)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _to_tree_priority(self, priorities: jax.Array) -> jax.Array:
+        p = jnp.maximum(priorities.astype(jnp.float32), self.eps)
+        return p ** self.alpha

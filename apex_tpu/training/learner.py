@@ -17,7 +17,7 @@ compiled into the same program instead of a host-side branch.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any, Callable, Protocol
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,18 @@ from apex_tpu.replay.device import DeviceReplay, ReplayState
 from apex_tpu.training.state import TrainState, create_train_state
 
 
+class ReplayLike(Protocol):
+    """The duck-typed replay contract LearnerCore depends on — satisfied by
+    both :class:`DeviceReplay` (stacked pytree batches) and
+    :class:`apex_tpu.replay.frame_pool.FramePoolReplay` (frame chunks)."""
+
+    def add(self, state, batch, priorities): ...
+
+    def sample(self, state, key, batch_size, beta): ...
+
+    def update_priorities(self, state, idx, priorities): ...
+
+
 @dataclass(frozen=True)
 class LearnerCore:
     """Static wiring of model/replay/optimizer into jitted step functions.
@@ -36,7 +48,7 @@ class LearnerCore:
     """
 
     apply_fn: Callable[..., jax.Array]
-    replay: DeviceReplay
+    replay: ReplayLike
     optimizer: optax.GradientTransformation
     batch_size: int = 512
     target_update_interval: int = 2500

@@ -5,8 +5,13 @@ separate replay server (``origin_repo/README.md:42``; BASELINE.md).  We
 measure the SAME unit of work, harder: each learner step here also ingests
 512 fresh transitions and performs the PER priority write-back on-device —
 work the reference offloads to its replay server — fused into one XLA
-program on the Atari-shape DuelingDQN (84x84x4 uint8, batch 512, 2^20 PER
-capacity).
+program on the Atari-shape DuelingDQN (84x84x4 uint8 stacks, batch 512).
+
+Replay is the frame-pool layout (apex_tpu/replay/frame_pool.py): 2^19
+transitions + 2^20 single frames resident in HBM (~7.5GB).  Per chip that
+is ~a quarter of the reference's 2e6-transition replay host; an 8-chip
+slice with per-chip shards doubles the reference's total capacity.  Stacks
+are gathered on device at sample time.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = value / 11.0 (midpoint of the reference's 10-12 range).
@@ -23,53 +28,79 @@ import numpy as np
 
 BASELINE_BPS = 11.0
 BATCH = 512
-OBS_SHAPE = (84, 84, 4)
-# Stacked-frame storage: obs+next_obs cost ~56KB/transition plus XLA tiling
-# padding (84 -> 128 on the tiled minor dim), so 2^16 * ~86KB = 5.6GB fits
-# v5e's 16GB HBM with headroom.  The frame-pool layout (one 84x84 frame
-# stored once, stacks gathered by index) is what restores 2^20+ capacity.
-CAPACITY = 2 ** 16
+FRAME_SHAPE = (84, 84, 1)
+FRAME_STACK = 4
+CAPACITY = 2 ** 19
+FRAME_CAPACITY = 2 ** 20
+CHUNK = 512            # transitions ingested per fused step
+CHUNK_FRAMES = 512 + 16
 WARMUP_STEPS = 3
 MEASURE_STEPS = 50
 
 
+def _synthetic_chunk(rng: np.random.Generator) -> tuple[dict, np.ndarray]:
+    """A representative actor chunk: CHUNK transitions over CHUNK_FRAMES
+    contiguous frames, stacks referencing chunk-relative windows."""
+    d = int(np.prod(FRAME_SHAPE))
+    base = np.minimum(np.arange(CHUNK), CHUNK_FRAMES - 1 - 3)
+    offs = np.arange(-(FRAME_STACK - 1), 1)
+    obs_ref = np.maximum(base[:, None] + offs[None, :], 0).astype(np.int32)
+    next_ref = np.minimum(obs_ref + 3, CHUNK_FRAMES - 1).astype(np.int32)
+    chunk = dict(
+        frames=rng.integers(0, 255, (CHUNK_FRAMES, d)).astype(np.uint8),
+        n_frames=np.int32(CHUNK_FRAMES),
+        n_trans=np.int32(CHUNK),
+        action=rng.integers(0, 6, CHUNK).astype(np.int32),
+        reward=rng.normal(size=CHUNK).astype(np.float32),
+        discount=np.full(CHUNK, 0.99 ** 3, np.float32),
+        obs_ref=obs_ref,
+        next_ref=next_ref,
+    )
+    prios = np.abs(rng.normal(size=CHUNK)).astype(np.float32) + 1e-3
+    return chunk, prios
+
+
 def main() -> None:
     from apex_tpu.models.dueling import DuelingDQN
-    from apex_tpu.training.learner import build_learner
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+    from apex_tpu.training.learner import LearnerCore
+    from apex_tpu.training.state import create_train_state
 
     model = DuelingDQN(num_actions=6)
-    example_obs = jnp.zeros((1,) + OBS_SHAPE, jnp.uint8)
-    core, ts, rs = build_learner(
-        model, CAPACITY, example_obs, jax.random.key(0), batch_size=BATCH,
-        target_update_interval=2500)
+    pool = FramePoolReplay(capacity=CAPACITY, frame_shape=FRAME_SHAPE,
+                           frame_stack=FRAME_STACK,
+                           frame_capacity=FRAME_CAPACITY)
+    optimizer = make_optimizer()
+    ts = create_train_state(
+        model, optimizer, jax.random.key(0),
+        jnp.zeros((1, 84, 84, FRAME_STACK), jnp.uint8))
+    core = LearnerCore(apply_fn=model.apply, replay=pool,
+                       optimizer=optimizer, batch_size=BATCH,
+                       target_update_interval=2500)
+    rs = pool.init()
 
     rng = np.random.default_rng(0)
-    host = dict(
-        obs=rng.integers(0, 255, (BATCH,) + OBS_SHAPE).astype(np.uint8),
-        action=rng.integers(0, 6, BATCH).astype(np.int32),
-        reward=rng.normal(size=BATCH).astype(np.float32),
-        next_obs=rng.integers(0, 255, (BATCH,) + OBS_SHAPE).astype(np.uint8),
-        discount=np.full(BATCH, 0.99 ** 3, np.float32))
-    ingest = jax.device_put(host)
-    prios = jnp.ones(BATCH, jnp.float32)
+    chunk, prios = _synthetic_chunk(rng)
+    chunk = jax.device_put(chunk)
+    prios = jax.device_put(jnp.asarray(prios))
 
     fused = core.jit_fused_step()
-    # pre-fill past a warmup's worth so sampling has mass
     for i in range(WARMUP_STEPS):
-        ts, rs, metrics = fused(ts, rs, ingest, prios, jax.random.key(i),
+        ts, rs, metrics = fused(ts, rs, chunk, prios, jax.random.key(i),
                                 jnp.float32(0.4))
     jax.block_until_ready(metrics["loss"])
 
     t0 = time.perf_counter()
     for i in range(MEASURE_STEPS):
-        ts, rs, metrics = fused(ts, rs, ingest, prios,
+        ts, rs, metrics = fused(ts, rs, chunk, prios,
                                 jax.random.key(100 + i), jnp.float32(0.4))
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 
     bps = MEASURE_STEPS / dt
     print(json.dumps({
-        "metric": "learner_batches_per_sec_batch512_with_per_ingest",
+        "metric": "learner_batches_per_sec_batch512_framepool_per_ingest",
         "value": round(bps, 2),
         "unit": "batches/s",
         "vs_baseline": round(bps / BASELINE_BPS, 2),

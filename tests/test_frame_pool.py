@@ -1,0 +1,282 @@
+"""Frame-pool replay: chunk builder + device pool vs. the stacked-obs oracle.
+
+The oracle is the already-tested NStepAccumulator fed by a host-side
+FrameStack emulation: for the same trajectory both paths must produce
+identical transitions, and gathering stacks from the device frame ring must
+reproduce the oracle's materialized stacked observations exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.replay.nstep import NStepAccumulator
+
+H = W = 8
+SHAPE = (H, W, 1)
+
+
+def _frame(rng):
+    return rng.integers(0, 255, SHAPE).astype(np.uint8)
+
+
+def _run_trajectory(rng, builder, oracle, n_episodes, ep_len_range,
+                    frame_stack, truncate_prob=0.3):
+    """Drive both paths with identical data; returns #transitions emitted."""
+    total = 0
+    for _ in range(n_episodes):
+        ep_len = int(rng.integers(*ep_len_range))
+        truncated_end = bool(rng.random() < truncate_prob)
+        f = _frame(rng)
+        builder.begin_episode(f)
+        stack = [f] * frame_stack
+        for t in range(ep_len):
+            action = int(rng.integers(0, 3))
+            reward = float(rng.normal())
+            q = rng.normal(size=3).astype(np.float32)
+            new_f = _frame(rng)
+            last = t == ep_len - 1
+            term = last and not truncated_end
+            trunc = last and truncated_end
+
+            obs_stacked = np.concatenate(stack, axis=-1)
+            np.testing.assert_array_equal(builder.current_stack(),
+                                          obs_stacked)
+            builder.add_step(action, reward, q, new_f, term, trunc)
+            next_stacked = np.concatenate((stack + [new_f])[1:], axis=-1)
+            oracle.add(obs_stacked, action, reward, q, terminated=term,
+                       truncated=trunc, final_obs=next_stacked)
+            stack = (stack + [new_f])[1:]
+            total += 1
+    return total
+
+
+@pytest.mark.parametrize("chunk_transitions", [8, 64])
+def test_matches_nstep_oracle(chunk_transitions):
+    n_steps, gamma, s = 3, 0.9, 4
+    rng = np.random.default_rng(0)
+    builder = FrameChunkBuilder(n_steps, gamma, s, SHAPE,
+                                chunk_transitions=chunk_transitions)
+    oracle = NStepAccumulator(n_steps, gamma)
+    n_trans = _run_trajectory(rng, builder, oracle, n_episodes=6,
+                              ep_len_range=(1, 12), frame_stack=s)
+
+    pool = FramePoolReplay(capacity=256, frame_shape=SHAPE, frame_stack=s)
+    state = pool.init()
+    add = jax.jit(pool.add)
+    for chunk in builder.force_flush():
+        prios = chunk.pop("priorities")
+        state = add(state, chunk, jnp.asarray(prios))
+
+    want_batch, want_prios = oracle.make_batch()
+    assert int(state.size) == n_trans == len(want_prios)
+
+    got_obs = np.asarray(pool._gather_stacks(state, state.obs_ids[:n_trans]))
+    got_next = np.asarray(pool._gather_stacks(state,
+                                              state.next_ids[:n_trans]))
+    np.testing.assert_array_equal(got_obs, want_batch["obs"])
+    np.testing.assert_array_equal(np.asarray(state.action[:n_trans]),
+                                  want_batch["action"])
+    np.testing.assert_allclose(np.asarray(state.reward[:n_trans]),
+                               want_batch["reward"], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(state.discount[:n_trans]),
+                               want_batch["discount"], rtol=1e-6)
+    # next_obs equality only where the bootstrap is live (discount > 0):
+    # terminated placeholders differ by design and are masked in the loss.
+    live = want_batch["discount"] > 0
+    np.testing.assert_array_equal(got_next[live], want_batch["next_obs"][live])
+    # priorities identical (same stored-Q trick both sides)
+    got_p = np.asarray(state.sum_tree[pool.capacity:pool.capacity + n_trans])
+    np.testing.assert_allclose(
+        got_p, np.maximum(want_prios, pool.eps) ** pool.alpha, rtol=1e-5)
+
+
+def test_wraparound_keeps_live_transitions_consistent():
+    """After the ring wraps, every live transition's gathered stack must
+    still reconstruct bit-exactly (frames outlive transitions)."""
+    n_steps, gamma, s = 2, 0.99, 2
+    rng = np.random.default_rng(1)
+    builder = FrameChunkBuilder(n_steps, gamma, s, SHAPE,
+                                chunk_transitions=8)
+    pool = FramePoolReplay(capacity=32, frame_shape=SHAPE, frame_stack=s)
+    state = pool.init()
+    add = jax.jit(pool.add)
+
+    # mirror of every transition ever emitted, in emission order
+    oracle = NStepAccumulator(n_steps, gamma)
+    emitted_obs, emitted_reward = [], []
+    for _ in range(10):  # 10 episodes x ~12 steps >> capacity 32
+        f = _frame(rng)
+        builder.begin_episode(f)
+        stack = [f] * s
+        ep_len = int(rng.integers(6, 14))
+        for t in range(ep_len):
+            a, r = int(rng.integers(0, 3)), float(rng.normal())
+            q = rng.normal(size=3).astype(np.float32)
+            new_f = _frame(rng)
+            term = t == ep_len - 1
+            obs_stacked = np.concatenate(stack, axis=-1)
+            builder.add_step(a, r, q, new_f, term, False)
+            oracle.add(obs_stacked, a, r, q, terminated=term)
+            stack = (stack + [new_f])[1:]
+        for chunk in builder.force_flush():
+            prios = chunk.pop("priorities")
+            state = add(state, chunk, jnp.asarray(prios))
+        b, _ = oracle.make_batch()
+        emitted_obs.extend(list(b["obs"]))
+        emitted_reward.extend(list(b["reward"]))
+
+    n_total = len(emitted_obs)
+    assert int(state.size) == 32
+    pos = int(state.pos)
+    # live slot i holds emission (n_total - 32) + ((i - pos) % 32)
+    got_obs = np.asarray(pool._gather_stacks(state, state.obs_ids))
+    for slot in range(32):
+        emission = n_total - 32 + ((slot - pos) % 32)
+        np.testing.assert_array_equal(got_obs[slot], emitted_obs[emission])
+        np.testing.assert_allclose(float(state.reward[slot]),
+                                   emitted_reward[emission], rtol=1e-6)
+
+
+def test_early_flush_on_frame_overflow_pads_by_repeating_last_row():
+    """Degenerate 1-step episodes overflow the frame budget before the
+    transition budget; the early-flushed chunk must pad every array by
+    repeating the last real row (the device collapses pads onto that row's
+    slot, so identical values are required)."""
+    builder = FrameChunkBuilder(3, 0.99, 2, SHAPE, chunk_transitions=16,
+                                frame_margin=2)  # Kf=18 < 2*16
+    rng = np.random.default_rng(2)
+    for _ in range(12):  # 12 episodes x 2 frames = 24 frames > 18
+        f = _frame(rng)
+        builder.begin_episode(f)
+        builder.add_step(0, 1.0, np.zeros(3, np.float32), _frame(rng),
+                         True, False)
+    chunks = builder.force_flush()
+    assert len(chunks) >= 2
+    early = chunks[0]
+    n_trans = int(early["n_trans"])
+    assert 1 <= n_trans < 16  # flushed before the transition budget filled
+    assert 1 <= int(early["n_frames"]) <= 18
+    for k in ("priorities", "action", "reward", "discount", "obs_ref",
+              "next_ref"):
+        for pad_row in early[k][n_trans:]:
+            np.testing.assert_array_equal(pad_row, early[k][n_trans - 1])
+    nf = int(early["n_frames"])
+    for pad_row in early["frames"][nf:]:
+        np.testing.assert_array_equal(pad_row, early["frames"][nf - 1])
+    # every chunk self-contained: refs within the frame rows
+    for c in chunks:
+        assert c["obs_ref"].max() < int(c["n_frames"])
+        assert c["next_ref"].max() < int(c["n_frames"])
+
+
+def test_stale_transitions_redirect_to_newest_slot(key):
+    """When frames outpace transitions and age out of the ring, sampling
+    must redirect the stale transitions to the newest slot instead of
+    returning stacks mixing unrelated episodes."""
+    s = 2
+    pool = FramePoolReplay(capacity=16, frame_shape=SHAPE, frame_stack=s,
+                           frame_capacity=8)
+    state = pool.init()
+    rng = np.random.default_rng(5)
+
+    def mk_chunk(tag):
+        # 4 transitions over 8 frames: deliberately 2x frame rate
+        frames = np.full((8, H * W), tag, np.uint8)
+        refs = np.stack([np.arange(4), np.arange(4) + 1], axis=1)
+        return dict(frames=frames, n_frames=np.int32(8), n_trans=np.int32(4),
+                    action=np.full(4, tag % 3, np.int32),
+                    reward=np.full(4, float(tag), np.float32),
+                    discount=np.full(4, 0.97, np.float32),
+                    obs_ref=refs.astype(np.int32),
+                    next_ref=(refs + 2).astype(np.int32))
+
+    for tag in range(1, 4):  # 3 chunks: 24 frame epochs >> F=8
+        state = pool.add(state, mk_chunk(tag), jnp.full(4, 1.0))
+
+    batch, weights, idx = pool.sample(state, key, 64, jnp.float32(0.4))
+    idx = np.asarray(idx)
+    newest = (int(state.pos) - 1) % 16
+    # slots 0..7 (chunks 1-2, epochs 0/8 vs f_epoch 24 -> age 24/16 > 8) are
+    # stale; only chunk-3 slots (8..11) and the newest-redirect are legal
+    assert set(idx.tolist()) <= {8, 9, 10, 11, newest}
+    # every sampled obs comes from chunk 3 (uniform tag 3)
+    np.testing.assert_array_equal(np.asarray(batch["obs"]),
+                                  np.full_like(np.asarray(batch["obs"]), 3))
+    assert bool(jnp.isfinite(weights).all())
+
+
+def test_sample_under_jit_shapes_and_weights(key):
+    s = 4
+    rng = np.random.default_rng(3)
+    builder = FrameChunkBuilder(3, 0.99, s, SHAPE, chunk_transitions=32)
+    pool = FramePoolReplay(capacity=128, frame_shape=SHAPE, frame_stack=s)
+    state = pool.init()
+    for _ in range(4):
+        f = _frame(rng)
+        builder.begin_episode(f)
+        for t in range(20):
+            builder.add_step(int(rng.integers(0, 3)), float(rng.normal()),
+                             rng.normal(size=3).astype(np.float32),
+                             _frame(rng), t == 19, False)
+    for chunk in builder.force_flush():
+        prios = chunk.pop("priorities")
+        state = pool.add(state, chunk, jnp.asarray(prios))
+
+    @jax.jit
+    def sample(state, key):
+        return pool.sample(state, key, 16, jnp.float32(0.4))
+
+    batch, weights, idx = sample(state, key)
+    assert batch["obs"].shape == (16, H, W, s) and batch["obs"].dtype == jnp.uint8
+    assert batch["next_obs"].shape == (16, H, W, s)
+    assert bool(jnp.isfinite(weights).all()) and bool((weights > 0).all())
+    assert bool((idx < state.size).all())
+
+    state = pool.update_priorities(state, idx, weights + 1.0)
+    assert bool(jnp.isfinite(state.sum_tree[1]))
+
+
+def test_learner_core_end_to_end_with_frame_pool(key):
+    """LearnerCore is duck-typed over the replay: the fused
+    ingest+sample+update step must run with FramePoolReplay."""
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.learner import LearnerCore
+    from apex_tpu.training.state import create_train_state
+
+    s, shape = 4, (16, 16, 1)
+    pool = FramePoolReplay(capacity=128, frame_shape=shape, frame_stack=s)
+    model = DuelingDQN(num_actions=3, compute_dtype=jnp.float32)
+    optimizer = make_optimizer(lr=1e-3)
+    ts = create_train_state(model, optimizer, key,
+                            jnp.zeros((1, 16, 16, s), jnp.uint8))
+    core = LearnerCore(apply_fn=model.apply, replay=pool,
+                       optimizer=optimizer, batch_size=16,
+                       target_update_interval=100)
+    state = pool.init()
+
+    rng = np.random.default_rng(4)
+    builder = FrameChunkBuilder(3, 0.99, s, shape, chunk_transitions=32)
+    for _ in range(3):
+        builder.begin_episode(rng.integers(0, 255, shape).astype(np.uint8))
+        for t in range(25):
+            builder.add_step(int(rng.integers(0, 3)), float(rng.normal()),
+                             rng.normal(size=3).astype(np.float32),
+                             rng.integers(0, 255, shape).astype(np.uint8),
+                             t == 24, False)
+    ingest = core.jit_ingest()
+    for chunk in builder.force_flush():
+        prios = chunk.pop("priorities")
+        state = ingest(state, chunk, jnp.asarray(prios))
+    assert int(state.size) == 75
+
+    step = core.jit_train_step()
+    ts2, state2, metrics = step(ts, state, jax.random.key(7),
+                                jnp.float32(0.4))
+    assert int(ts2.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["grad_norm"]) > 0
