@@ -1,0 +1,250 @@
+"""In-host actor pool: worker processes feeding the learner's device replay.
+
+Capability parity with the reference's ``BatchRecorder``/``Worker``
+(``batchrecorder.py:79-152``) redesigned for the TPU topology:
+
+* Each worker is an ``mp.Process`` with its own env, its own CPU-jitted
+  policy, and a :class:`~apex_tpu.replay.frame_chunks.FrameChunkBuilder` —
+  transitions ship as fixed-shape frame chunks ready for device ingest,
+  priorities already computed from acting-time Q-values.
+* Per-worker exploration ladder ``eps_base ** (1 + i/(N-1) * eps_alpha)``
+  (``batchrecorder.py:121``, the Ape-X schedule).
+* Unlike the reference's synchronous task rounds (``record_batch`` +
+  ``queue.join`` — and the eager-call quirk at ``ApeX.py:94-97`` that made
+  acting and learning fully sequential), workers run CONTINUOUSLY and the
+  learner drains a bounded chunk queue — acting and the TPU step overlap.
+* Param distribution is latest-wins, version-stamped: the learner puts
+  ``(version, params)`` on per-worker depth-2 queues; workers drain and keep
+  the newest (the reference's SUB+CONFLATE semantics, ``actor.py:40-49``),
+  polling every ``update_interval`` env steps (``actor.py:97-103``).
+
+Workers are forced onto the CPU JAX platform: the image's sitecustomize
+would otherwise dial the single-client TPU tunnel from every spawned
+process and deadlock.  The pool clears ``PALLAS_AXON_POOL_IPS`` and sets
+``JAX_PLATFORMS=cpu`` in the parent's environment around ``Process.start``
+so children inherit it before their interpreter boots.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as queue_lib
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig
+
+
+def actor_epsilons(n: int, eps_base: float = 0.4,
+                   eps_alpha: float = 7.0) -> np.ndarray:
+    """The Ape-X per-actor exploration ladder (``batchrecorder.py:121``)."""
+    if n == 1:
+        return np.asarray([eps_base], np.float64)
+    i = np.arange(n, dtype=np.float64)
+    return eps_base ** (1.0 + i / (n - 1) * eps_alpha)
+
+
+@dataclass
+class EpisodeStat:
+    actor_id: int
+    reward: float
+    length: int
+    param_version: int = 0          # staleness observability
+
+
+def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                 chunk_queue: mp.Queue, param_queue: mp.Queue,
+                 stat_queue: mp.Queue, stop_event, epsilon: float,
+                 chunk_transitions: int) -> None:
+    """Worker process body (reference ``Worker.run``, ``batchrecorder.py:79-98``)."""
+    # Imports happen here so jax initializes on the CPU platform set by the
+    # parent around spawn.
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.envs.registry import make_env, unstacked_env_spec
+    from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+    from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+
+    seed = cfg.env.seed + 1000 * (actor_id + 1)
+    env_cfg = cfg.env
+    env = make_env(env_cfg.env_id, env_cfg, seed=seed,
+                   max_episode_steps=cfg.actor.max_episode_length,
+                   stack_frames=False)
+    frame_shape, frame_dtype, frame_stack = unstacked_env_spec(env, env_cfg)
+
+    model = DuelingDQN(**model_spec)
+    policy = jax.jit(make_policy_fn(model))
+    key = jax.random.key(seed)
+
+    while True:                                  # block for first publish,
+        if stop_event.is_set():                  # but stay interruptible
+            env.close()
+            return
+        try:
+            version, params = param_queue.get(timeout=0.5)
+            break
+        except queue_lib.Empty:
+            continue
+    builder = FrameChunkBuilder(
+        cfg.learner.n_steps, cfg.learner.gamma, frame_stack, frame_shape,
+        chunk_transitions=chunk_transitions, frame_dtype=frame_dtype)
+
+    anneal = cfg.actor.eps_anneal_steps
+    total_steps = 0
+
+    def current_eps() -> float:
+        if not anneal:
+            return epsilon
+        import math
+        return epsilon + (1.0 - epsilon) * math.exp(-total_steps / anneal)
+
+    steps_since_poll = 0
+    obs, _ = env.reset(seed=seed)
+    builder.begin_episode(obs)
+    ep_reward, ep_len = 0.0, 0
+
+    while not stop_event.is_set():
+        # CONFLATE param poll (actor.py:97-103)
+        steps_since_poll += 1
+        if steps_since_poll >= cfg.actor.update_interval:
+            steps_since_poll = 0
+            try:
+                while True:
+                    version, params = param_queue.get_nowait()
+            except queue_lib.Empty:
+                pass
+
+        stack = builder.current_stack()
+        key, akey = jax.random.split(key)
+        actions, q = policy(params, stack[None],
+                            jnp.float32(current_eps()), akey)
+        action = int(actions[0])
+        total_steps += 1
+
+        next_obs, reward, terminated, truncated, _ = env.step(action)
+        builder.add_step(action, float(reward), np.asarray(q[0]),
+                         next_obs, bool(terminated), bool(truncated))
+        ep_reward += float(reward)
+        ep_len += 1
+
+        for chunk in builder.poll():
+            chunk_queue.put(("chunk", actor_id, chunk))   # blocks when full
+        if terminated or truncated:
+            try:
+                stat_queue.put_nowait(
+                    EpisodeStat(actor_id, ep_reward, ep_len, version))
+            except queue_lib.Full:
+                pass
+            ep_reward, ep_len = 0.0, 0
+            obs, _ = env.reset()
+            builder.begin_episode(obs)
+        else:
+            obs = next_obs
+
+    env.close()
+
+
+class ActorPool:
+    """Fan-out/fan-in around N continuously-running actor workers
+    (reference ``BatchRecorder``, ``batchrecorder.py:100-152``)."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict,
+                 chunk_transitions: int, chunk_queue_depth: int = 64):
+        self.cfg = cfg
+        n = cfg.actor.n_actors
+        ctx = mp.get_context("spawn")
+        self.chunk_queue: mp.Queue = ctx.Queue(maxsize=chunk_queue_depth)
+        self.stat_queue: mp.Queue = ctx.Queue(maxsize=1024)
+        self.param_queues = [ctx.Queue(maxsize=2) for _ in range(n)]
+        self.stop_event = ctx.Event()
+        eps = actor_epsilons(n, cfg.actor.eps_base, cfg.actor.eps_alpha)
+        self.procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(i, cfg, model_spec, self.chunk_queue,
+                      self.param_queues[i], self.stat_queue, self.stop_event,
+                      float(eps[i]), chunk_transitions),
+                daemon=True)
+            for i in range(n)
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn workers with a CPU-pinned JAX environment (module docstring)."""
+        saved = {k: os.environ.get(k)
+                 for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        try:
+            for p in self.procs:
+                p.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def cleanup(self) -> None:
+        """Stop workers (reference ``BatchRecorder.cleanup``,
+        ``batchrecorder.py:148-152``)."""
+        self.stop_event.set()
+        # unblock workers stuck on a full chunk queue
+        try:
+            while True:
+                self.chunk_queue.get_nowait()
+        except queue_lib.Empty:
+            pass
+        for p in self.procs:
+            p.join(timeout=5)
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        # Detach queue feeder threads: a dead child never drains its pipe, and
+        # the default atexit join would hang the parent forever.
+        for q in [self.chunk_queue, self.stat_queue, *self.param_queues]:
+            q.cancel_join_thread()
+            q.close()
+
+    # -- data/param planes -------------------------------------------------
+
+    def publish_params(self, version: int, params: Any) -> None:
+        """Latest-wins broadcast (reference ``set_worker_weights``,
+        ``batchrecorder.py:140-146``, + PUB/CONFLATE semantics)."""
+        for q in self.param_queues:
+            while True:  # drop the stalest entry if the depth-2 queue is full
+                try:
+                    q.put_nowait((version, params))
+                    break
+                except queue_lib.Full:
+                    try:
+                        q.get_nowait()
+                    except queue_lib.Empty:
+                        pass
+
+    def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:
+        """Drain up to ``max_chunks`` transition chunks."""
+        out = []
+        for _ in range(max_chunks):
+            try:
+                msg = self.chunk_queue.get(timeout=timeout) if timeout \
+                    else self.chunk_queue.get_nowait()
+            except queue_lib.Empty:
+                break
+            out.append(msg[2])
+        return out
+
+    def poll_stats(self) -> list[EpisodeStat]:
+        out = []
+        try:
+            while True:
+                out.append(self.stat_queue.get_nowait())
+        except queue_lib.Empty:
+            pass
+        return out

@@ -68,6 +68,12 @@ class ActorConfig:
     update_interval: int = 400       # env steps between param refresh polls
     eps_base: float = 0.4            # per-actor ladder eps_base^(1 + i/(N-1)*eps_alpha)
     eps_alpha: float = 7.0
+    # Anneal each worker's epsilon 1.0 -> its ladder value over this many of
+    # its own env steps (exp decay).  0 = reference behavior (fixed ladder,
+    # batchrecorder.py:121) — correct for large fleets where low-eps actors
+    # can free-ride on the explorers' data; small fleets (CI, few actors)
+    # need the anneal or greedy actors feed degenerate data from step 0.
+    eps_anneal_steps: int = 0
     # None = the env's own limit; reference Atari deployments use 50_000
     # (wrapper.py:282-298 TimeLimit via arguments.py max_episode_length)
     max_episode_length: int | None = None

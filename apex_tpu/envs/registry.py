@@ -46,7 +46,8 @@ def make_atari(env_id: str, skip: int = 4,
     return env
 
 
-def wrap_atari_dqn(env: gym.Env, cfg: EnvConfig) -> gym.Env:
+def wrap_atari_dqn(env: gym.Env, cfg: EnvConfig,
+                   stack_frames: bool = True) -> gym.Env:
     """DeepMind preprocessing stack (reference: wrapper.py:316-329)."""
     if cfg.episodic_life:
         env = wrappers.EpisodicLifeEnv(env)
@@ -55,15 +56,21 @@ def wrap_atari_dqn(env: gym.Env, cfg: EnvConfig) -> gym.Env:
     env = wrappers.WarpFrame(env)
     if cfg.clip_rewards:
         env = wrappers.ClipRewardEnv(env)
-    if cfg.frame_stack > 1:
+    if stack_frames and cfg.frame_stack > 1:
         env = wrappers.FrameStack(env, cfg.frame_stack)
     return env
 
 
 def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
              seed: int | None = None,
-             max_episode_steps: int | None = None) -> gym.Env:
-    """One-stop constructor used by every role (actor/evaluator/driver)."""
+             max_episode_steps: int | None = None,
+             stack_frames: bool = True) -> gym.Env:
+    """One-stop constructor used by every role (actor/evaluator/driver).
+
+    ``stack_frames=False`` omits the FrameStack wrapper: actors feeding the
+    frame-pool replay consume SINGLE frames (stacking happens on device at
+    sample time; the acting stack lives in FrameChunkBuilder).
+    """
     cfg = cfg or EnvConfig()
     env_id = env_id or cfg.env_id
 
@@ -74,17 +81,28 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
         env = toy.CatchEnv()
         if max_episode_steps is not None:
             env = wrappers.TimeLimit(env, max_episode_steps)
-        if cfg.frame_stack > 1:
+        if stack_frames and cfg.frame_stack > 1:
             env = wrappers.FrameStack(env, cfg.frame_stack)
     else:
         env = make_atari(env_id, skip=cfg.frame_skip,
                          max_episode_steps=max_episode_steps)
-        env = wrap_atari_dqn(env, cfg)
+        env = wrap_atari_dqn(env, cfg, stack_frames=stack_frames)
 
     if seed is not None:
         env.reset(seed=seed)
         env.action_space.seed(seed)
     return env
+
+
+def unstacked_env_spec(env: gym.Env,
+                       cfg: EnvConfig) -> tuple[tuple[int, ...], Any, int]:
+    """(frame_shape, frame_dtype, frame_stack) for an env built with
+    ``stack_frames=False`` — the FrameChunkBuilder/FramePoolReplay spec.
+    Vector (1-D) observations use frame_stack=1."""
+    space = env.observation_space
+    shape = tuple(space.shape)
+    stack = cfg.frame_stack if len(shape) == 3 else 1
+    return shape, space.dtype, stack
 
 
 def make_eval_env(env_id: str | None = None, cfg: EnvConfig | None = None,

@@ -42,11 +42,13 @@ class FrameChunkBuilder:
     def __init__(self, n_steps: int, gamma: float, frame_stack: int,
                  frame_shape: tuple[int, ...],
                  chunk_transitions: int = 64,
-                 frame_margin: int = 16):
+                 frame_margin: int = 16,
+                 frame_dtype=np.uint8):
         self.n = n_steps
         self.gamma = gamma
         self.s = frame_stack
         self.frame_shape = tuple(frame_shape)
+        self.frame_dtype = np.dtype(frame_dtype)
         self.frame_dim = int(np.prod(frame_shape))
         self.K = chunk_transitions
         self.Kf = chunk_transitions + frame_margin
@@ -73,7 +75,7 @@ class FrameChunkBuilder:
 
     def _register_frame(self, ep_idx: int, frame: np.ndarray) -> None:
         self._ep2chunk[ep_idx] = len(self._frames)
-        self._frames.append(np.asarray(frame, np.uint8).reshape(-1))
+        self._frames.append(np.asarray(frame, self.frame_dtype).reshape(-1))
 
     def _maybe_flush_for_frames(self, incoming: int = 1) -> None:
         if len(self._frames) + incoming > self.Kf:
@@ -94,7 +96,7 @@ class FrameChunkBuilder:
         self._maybe_flush_for_frames()
         self._ep_step = 0
         self._recent.clear()
-        self._recent.append((0, np.asarray(frame, np.uint8)))
+        self._recent.append((0, np.asarray(frame, self.frame_dtype)))
         self._ep2chunk = {}
         self._register_frame(0, frame)
 
@@ -119,7 +121,7 @@ class FrameChunkBuilder:
         self._maybe_flush_for_frames()
         obs_idx = self._ep_step
         self._ep_step += 1
-        self._recent.append((self._ep_step, np.asarray(new_frame, np.uint8)))
+        self._recent.append((self._ep_step, np.asarray(new_frame, self.frame_dtype)))
         self._register_frame(self._ep_step, new_frame)
         self._window.append((obs_idx, action, float(reward),
                              np.asarray(q_values, np.float32)))
@@ -204,7 +206,7 @@ class FrameChunkBuilder:
             return arr
 
         chunk = dict(
-            frames=pad_to(self._frames, self.Kf, np.uint8),
+            frames=pad_to(self._frames, self.Kf, self.frame_dtype),
             n_frames=np.int32(n_frames),
             n_trans=np.int32(n_trans),
             action=pad_to(t["action"], self.K, np.int32),

@@ -83,14 +83,18 @@ class FramePoolState:
 class FramePoolReplay(PERMethods):
     """Static spec + pure methods (hashable; closes over jits).
 
-    ``frame_shape`` is one frame's (H, W, c); sampled observations are
-    ``(B, H, W, S*c)`` uint8, oldest frame first on the channel axis.
+    ``frame_shape`` is one frame's shape — (H, W, c) for pixels, (D,) for
+    vector observations (``frame_stack=1`` stores plain vectors; >1
+    concatenates on the last axis like pixel channel stacking).  Sampled
+    observations are ``(B, *frame_shape[:-1], S * frame_shape[-1])`` in
+    ``frame_dtype``, oldest frame first on the last axis.
     """
 
     capacity: int
     frame_shape: tuple[int, ...] = (84, 84, 1)
     frame_stack: int = 4
     frame_capacity: int | None = None
+    frame_dtype: str = "uint8"
     alpha: float = 0.6
     eps: float = 1e-6
 
@@ -114,7 +118,8 @@ class FramePoolReplay(PERMethods):
         with :meth:`DeviceReplay.init` (shapes come from the spec)."""
         c, s = self.capacity, self.frame_stack
         return FramePoolState(
-            frames=jnp.zeros((self.f_capacity, self.frame_dim), jnp.uint8),
+            frames=jnp.zeros((self.f_capacity, self.frame_dim),
+                             jnp.dtype(self.frame_dtype)),
             action=jnp.zeros(c, jnp.int32),
             reward=jnp.zeros(c, jnp.float32),
             discount=jnp.zeros(c, jnp.float32),
@@ -210,12 +215,14 @@ class FramePoolReplay(PERMethods):
 
     def _gather_stacks(self, state: FramePoolState,
                        ids: jax.Array) -> jax.Array:
-        """(B, S) frame-ring rows -> (B, H, W, S*c) uint8, oldest first."""
+        """(B, S) frame-ring rows -> (B, *shape[:-1], S*shape[-1]),
+        oldest frame first on the last axis."""
         b, s = ids.shape
-        h, w, ch = self.frame_shape
+        shape = self.frame_shape
         rows = state.frames[ids.reshape(-1)]            # (B*S, D)
-        rows = rows.reshape(b, s, h, w, ch)
-        return jnp.moveaxis(rows, 1, 3).reshape(b, h, w, s * ch)
+        rows = rows.reshape(b, s, *shape)
+        rows = jnp.moveaxis(rows, 1, -2)                # stack before channel
+        return rows.reshape(b, *shape[:-1], s * shape[-1])
 
     # -- helpers -----------------------------------------------------------
 

@@ -1,0 +1,238 @@
+"""Ape-X driver: actor pool + fused TPU learner, actually concurrent.
+
+Capability parity with the reference ``ApeX.py`` (C11) and its
+``origin_repo`` flagship topology, on one host:
+
+* N worker processes explore continuously with the epsilon ladder and ship
+  fixed-shape frame chunks with precomputed priorities
+  (:mod:`apex_tpu.actors.pool`).
+* The learner ingests chunks into the HBM frame-pool replay and runs the
+  fused sample/loss/update/priority step — ingest+train fuse into one XLA
+  program whenever a chunk is pending.
+* Params publish version-stamped every ``publish_interval`` learner steps
+  with a wall-clock floor (``publish_min_seconds``) — the reference's
+  every-25-steps cadence (``learner.py:169-170``) assumed an 11-steps/s
+  learner; at TPU step rates a pure step cadence would saturate the host
+  queues.
+* Warmup gate: no training until ``replay.warmup`` transitions are resident
+  (``arguments.py:47-48``, ``replay.py:104-106``).
+
+The reference's ``ApeX.py`` accidentally ran acting and learning
+sequentially (``Process(target=test.sampling_data())`` calls the method
+eagerly — ``ApeX.py:94-97``); here they genuinely overlap: workers are
+independent processes, and the learner thread blocks only on device results.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.actors.pool import ActorPool
+from apex_tpu.config import ApexConfig
+from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
+                                    unstacked_env_spec)
+from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.training.learner import LearnerCore
+from apex_tpu.training.state import create_train_state
+from apex_tpu.utils.metrics import MetricLogger, RateCounter
+from apex_tpu.utils.seeding import set_global_seeds
+
+
+class ApexTrainer:
+    """train_DQN-equivalent driver (``ApeX.py:13-82``), frame-pool edition."""
+
+    def __init__(self, config: ApexConfig | None = None,
+                 logdir: str | None = None, verbose: bool = False,
+                 publish_min_seconds: float = 0.2,
+                 train_ratio: float | None = None,
+                 min_train_ratio: float | None = None):
+        """Replay-ratio control (samples consumed per transition ingested):
+
+        ``train_ratio`` caps the ratio — the learner idles when it has
+        consumed too much per ingested transition (prevents overfitting a
+        slow actor fleet).  ``min_train_ratio`` FLOORS it — when the learner
+        falls behind, chunk draining pauses so the bounded queue
+        backpressures the actors (workers block on put), throttling
+        collection to what the learner can digest.  Without the floor, a
+        fast fleet can flood the buffer with data from a still-bad policy
+        faster than the learner improves it — the failure mode does not
+        exist in the reference only because its single-GPU learner was never
+        outpaced this way.  ``None`` = fully decoupled (reference behavior).
+        """
+        self.cfg = cfg = config or ApexConfig()
+        self.key = set_global_seeds(cfg.env.seed)
+        self.publish_min_seconds = publish_min_seconds
+        self.train_ratio = train_ratio
+        self.min_train_ratio = min_train_ratio
+        if (train_ratio is not None and min_train_ratio is not None
+                and min_train_ratio > train_ratio):
+            raise ValueError("min_train_ratio must be <= train_ratio")
+
+        probe = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
+                         stack_frames=False)
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            probe, cfg.env)
+        self.model_spec = dict(
+            num_actions=num_actions(probe),
+            obs_is_image=len(frame_shape) == 3,
+            compute_dtype=jnp.dtype(cfg.learner.compute_dtype),
+            scale_uint8=np.dtype(frame_dtype) == np.uint8)
+        probe.close()
+
+        self.model = DuelingDQN(**self.model_spec)
+        self.replay = FramePoolReplay(
+            capacity=cfg.replay.capacity, frame_shape=frame_shape,
+            frame_stack=frame_stack, frame_dtype=np.dtype(frame_dtype).name,
+            alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+        lc = cfg.learner
+        optimizer = make_optimizer(
+            lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
+            centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm)
+        stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+        self.key, init_key = jax.random.split(self.key)
+        self.train_state = create_train_state(
+            self.model, optimizer, init_key,
+            jnp.zeros((1,) + stacked, frame_dtype))
+        self.core = LearnerCore(
+            apply_fn=self.model.apply, replay=self.replay, optimizer=optimizer,
+            batch_size=lc.batch_size,
+            target_update_interval=lc.target_update_interval)
+        self.replay_state = self.replay.init()
+        self._fused = self.core.jit_fused_step()
+        self._train = self.core.jit_train_step()
+        self._ingest = self.core.jit_ingest()
+        self._policy = jax.jit(make_policy_fn(self.model))
+
+        self.pool = ActorPool(cfg, self.model_spec,
+                              chunk_transitions=cfg.actor.send_interval)
+        self.log = MetricLogger("learner", logdir, verbose=verbose)
+        self.steps_rate = RateCounter()
+        self.frames_rate = RateCounter()
+        self.ingested = 0
+        self.param_version = 0
+
+    # -- param plane -------------------------------------------------------
+
+    def _publish(self) -> None:
+        self.param_version += 1
+        host_params = jax.device_get(self.train_state.params)
+        self.pool.publish_params(self.param_version, host_params)
+
+    # -- main loop ---------------------------------------------------------
+
+    def train(self, total_steps: int, max_seconds: float = 3600.0,
+              log_every: int = 200):
+        """Run until ``total_steps`` learner updates (or the wall clock)."""
+        cfg = self.cfg
+        pool = self.pool
+        pool.start()
+        try:
+            self._publish()
+            last_publish = time.monotonic()
+            t_end = last_publish + max_seconds
+            episode_idx = 0
+
+            while self.steps_rate.total < total_steps:
+                now = time.monotonic()
+                if now > t_end:
+                    break
+                warm = self.ingested >= cfg.replay.warmup
+                consumed = self.steps_rate.total * self.core.batch_size
+                budget = (float("inf") if self.train_ratio is None
+                          else self.ingested * self.train_ratio
+                          / self.core.batch_size)
+                # Replay-ratio floor: learner behind -> pause draining so the
+                # bounded chunk queue backpressures the actor fleet.
+                behind = (warm and self.min_train_ratio is not None
+                          and consumed < self.ingested * self.min_train_ratio)
+
+                chunk = None
+                if not behind:
+                    chunks = pool.poll_chunks(1, timeout=0 if warm else 0.05)
+                    if chunks:
+                        chunk = chunks[0]
+
+                if chunk is not None:
+                    prios = jnp.asarray(chunk.pop("priorities"))
+                    n_new = int(chunk["n_trans"])
+                    if warm:
+                        self.key, k = jax.random.split(self.key)
+                        self.train_state, self.replay_state, metrics = \
+                            self._fused(self.train_state, self.replay_state,
+                                        chunk, prios, k,
+                                        jnp.float32(self._beta()))
+                        self.steps_rate.tick()
+                    else:
+                        self.replay_state = self._ingest(
+                            self.replay_state, chunk, prios)
+                    self.ingested += n_new
+                    self.frames_rate.tick(n_new)
+                elif warm and self.steps_rate.total < budget:
+                    self.key, k = jax.random.split(self.key)
+                    self.train_state, self.replay_state, metrics = \
+                        self._train(self.train_state, self.replay_state, k,
+                                    jnp.float32(self._beta()))
+                    self.steps_rate.tick()
+                elif warm:
+                    time.sleep(0.002)   # replay-ratio cap reached
+
+                steps = self.steps_rate.total
+                if steps and (steps % cfg.learner.publish_interval == 0
+                              or now - last_publish
+                              > 10 * self.publish_min_seconds) \
+                        and now - last_publish >= self.publish_min_seconds:
+                    self._publish()
+                    last_publish = now
+
+                for stat in pool.poll_stats():
+                    self.log.scalars(
+                        {"episode_reward": stat.reward,
+                         "episode_length": stat.length,
+                         "actor_id": stat.actor_id}, episode_idx)
+                    episode_idx += 1
+
+                if warm and steps and steps % log_every == 0:
+                    self.log.scalars(
+                        {k: float(v) for k, v in metrics.items()}
+                        | {"bps": self.steps_rate.rate,
+                           "fps": self.frames_rate.rate,
+                           "param_version": self.param_version,
+                           "ingested": self.ingested}, steps)
+        finally:
+            pool.cleanup()
+        return self
+
+    def _beta(self) -> float:
+        frac = min(1.0, self.ingested / max(1, 10 * self.cfg.replay.warmup))
+        return self.cfg.replay.beta + (1.0 - self.cfg.replay.beta) * frac
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
+                 max_steps: int = 10_000) -> float:
+        """True-score eval on the unclipped, full-episode env
+        (``eval.py:49-87``)."""
+        if not hasattr(self, "_eval_env"):
+            self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
+                                           seed=self.cfg.env.seed + 999)
+        rewards = []
+        for ep in range(episodes):
+            obs, _ = self._eval_env.reset(seed=self.cfg.env.seed + 1000 + ep)
+            total, done, steps = 0.0, False, 0
+            while not done and steps < max_steps:
+                self.key, k = jax.random.split(self.key)
+                a, _ = self._policy(self.train_state.params,
+                                    np.asarray(obs)[None],
+                                    jnp.float32(epsilon), k)
+                obs, r, term, trunc, _ = self._eval_env.step(int(a[0]))
+                total += float(r)
+                done = term or trunc
+                steps += 1
+            rewards.append(total)
+        return float(np.mean(rewards))
