@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-_RELU_GAIN = jnp.sqrt(2.0)  # torch nn.init.calculate_gain('relu')
+_RELU_GAIN = 2.0 ** 0.5  # torch nn.init.calculate_gain('relu'); plain Python
+# float so importing this module never touches a JAX backend (the driver's
+# multi-chip dryrun must configure the platform before any device work).
 
 
 def orthogonal_init(gain: float = _RELU_GAIN):
