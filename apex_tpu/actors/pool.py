@@ -30,6 +30,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import queue as queue_lib
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -55,31 +56,69 @@ class EpisodeStat:
     param_version: int = 0          # staleness observability
 
 
-def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
-                 chunk_queue: mp.Queue, param_queue: mp.Queue,
-                 stat_queue: mp.Queue, stop_event, epsilon: float,
-                 chunk_transitions: int) -> None:
-    """Worker process body (reference ``Worker.run``, ``batchrecorder.py:79-98``)."""
-    # Imports happen here so jax initializes on the CPU platform set by the
-    # parent around spawn.
+class DQNWorkerFamily:
+    """DQN acting/recording hooks for :func:`worker_loop` (reference
+    ``Worker.run``, ``batchrecorder.py:79-98``): epsilon-greedy over the
+    builder's acting stack, frame-chunk emission."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seed: int,
+                 chunk_transitions: int):
+        import jax
+
+        from apex_tpu.envs.registry import make_env, unstacked_env_spec
+        from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+        from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+
+        self.seed = seed
+        self.env = make_env(cfg.env.env_id, cfg.env, seed=seed,
+                            max_episode_steps=cfg.actor.max_episode_length,
+                            stack_frames=False)
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            self.env, cfg.env)
+        self.policy = jax.jit(make_policy_fn(DuelingDQN(**model_spec)))
+        self.builder = FrameChunkBuilder(
+            cfg.learner.n_steps, cfg.learner.gamma, frame_stack, frame_shape,
+            chunk_transitions=chunk_transitions, frame_dtype=frame_dtype)
+
+    def begin_episode(self, obs) -> None:
+        self.builder.begin_episode(obs)
+
+    def step(self, params, obs, epsilon: float, key):
+        import jax.numpy as jnp
+        stack = self.builder.current_stack()
+        actions, q = self.policy(params, stack[None], jnp.float32(epsilon),
+                                 key)
+        action = int(actions[0])
+        next_obs, reward, term, trunc, _ = self.env.step(action)
+        self.builder.add_step(action, float(reward), np.asarray(q[0]),
+                              next_obs, bool(term), bool(trunc))
+        return next_obs, float(reward), bool(term), bool(trunc)
+
+    def poll_msgs(self) -> list[dict]:
+        out = []
+        for chunk in self.builder.poll():
+            out.append({"payload": chunk,
+                        "priorities": chunk.pop("priorities"),
+                        "n_trans": int(chunk["n_trans"])})
+        return out
+
+
+def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
+                param_queue, stat_queue, stop_event, epsilon: float) -> None:
+    """The family-agnostic worker lifecycle: interruptible wait for the
+    first publish, CONFLATE param polls every ``update_interval`` steps
+    (``actor.py:97-103``), exploration-epsilon anneal, chunk shipping with
+    backpressure, episode stats, clean shutdown.  The acting/recording
+    specifics live in ``family`` (:class:`DQNWorkerFamily`,
+    ``apex_tpu.actors.aql.AQLWorkerFamily``) — one lifecycle, N families,
+    where the reference maintains near-copies (``batchrecorder.py`` vs
+    ``batchrecoder_AQL.py``)."""
+    import math
+
     import jax
-    import jax.numpy as jnp
 
-    from apex_tpu.envs.registry import make_env, unstacked_env_spec
-    from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
-    from apex_tpu.replay.frame_chunks import FrameChunkBuilder
-
-    seed = cfg.env.seed + 1000 * (actor_id + 1)
-    env_cfg = cfg.env
-    env = make_env(env_cfg.env_id, env_cfg, seed=seed,
-                   max_episode_steps=cfg.actor.max_episode_length,
-                   stack_frames=False)
-    frame_shape, frame_dtype, frame_stack = unstacked_env_spec(env, env_cfg)
-
-    model = DuelingDQN(**model_spec)
-    policy = jax.jit(make_policy_fn(model))
-    key = jax.random.key(seed)
-
+    key = jax.random.key(family.seed)
+    env = family.env
     while True:                                  # block for first publish,
         if stop_event.is_set():                  # but stay interruptible
             env.close()
@@ -89,9 +128,6 @@ def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
             break
         except queue_lib.Empty:
             continue
-    builder = FrameChunkBuilder(
-        cfg.learner.n_steps, cfg.learner.gamma, frame_stack, frame_shape,
-        chunk_transitions=chunk_transitions, frame_dtype=frame_dtype)
 
     anneal = cfg.actor.eps_anneal_steps
     total_steps = 0
@@ -99,40 +135,32 @@ def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
     def current_eps() -> float:
         if not anneal:
             return epsilon
-        import math
         return epsilon + (1.0 - epsilon) * math.exp(-total_steps / anneal)
 
     steps_since_poll = 0
-    obs, _ = env.reset(seed=seed)
-    builder.begin_episode(obs)
+    obs, _ = env.reset(seed=family.seed)
+    family.begin_episode(obs)
     ep_reward, ep_len = 0.0, 0
 
     while not stop_event.is_set():
-        # CONFLATE param poll (actor.py:97-103)
         steps_since_poll += 1
         if steps_since_poll >= cfg.actor.update_interval:
             steps_since_poll = 0
             try:
-                while True:
+                while True:                      # keep only the newest
                     version, params = param_queue.get_nowait()
             except queue_lib.Empty:
                 pass
 
-        stack = builder.current_stack()
         key, akey = jax.random.split(key)
-        actions, q = policy(params, stack[None],
-                            jnp.float32(current_eps()), akey)
-        action = int(actions[0])
+        obs, reward, terminated, truncated = family.step(
+            params, obs, current_eps(), akey)
         total_steps += 1
-
-        next_obs, reward, terminated, truncated, _ = env.step(action)
-        builder.add_step(action, float(reward), np.asarray(q[0]),
-                         next_obs, bool(terminated), bool(truncated))
-        ep_reward += float(reward)
+        ep_reward += reward
         ep_len += 1
 
-        for chunk in builder.poll():
-            chunk_queue.put(("chunk", actor_id, chunk))   # blocks when full
+        for msg in family.poll_msgs():
+            chunk_queue.put(("chunk", actor_id, msg))     # blocks when full
         if terminated or truncated:
             try:
                 stat_queue.put_nowait(
@@ -141,19 +169,37 @@ def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
                 pass
             ep_reward, ep_len = 0.0, 0
             obs, _ = env.reset()
-            builder.begin_episode(obs)
-        else:
-            obs = next_obs
+            family.begin_episode(obs)
 
     env.close()
 
 
+def _worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                 chunk_queue: mp.Queue, param_queue: mp.Queue,
+                 stat_queue: mp.Queue, stop_event, epsilon: float,
+                 chunk_transitions: int) -> None:
+    """DQN worker process body.  Imports (and therefore jax platform
+    selection) happen in the child, under the CPU env set by the parent."""
+    family = DQNWorkerFamily(cfg, model_spec,
+                             seed=cfg.env.seed + 1000 * (actor_id + 1),
+                             chunk_transitions=chunk_transitions)
+    worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
+                stop_event, epsilon)
+
+
 class ActorPool:
     """Fan-out/fan-in around N continuously-running actor workers
-    (reference ``BatchRecorder``, ``batchrecorder.py:100-152``)."""
+    (reference ``BatchRecorder``, ``batchrecorder.py:100-152``).
+
+    ``worker_fn`` is the process body — the queue/lifecycle machinery is
+    family-agnostic; the DQN body is the default and the AQL family plugs
+    in its own (reference ``batchrecoder_AQL.py`` is a near-copy of
+    ``batchrecorder.py`` for the same reason, solved here by injection).
+    """
 
     def __init__(self, cfg: ApexConfig, model_spec: dict,
-                 chunk_transitions: int, chunk_queue_depth: int = 64):
+                 chunk_transitions: int, chunk_queue_depth: int = 64,
+                 worker_fn=None):
         self.cfg = cfg
         n = cfg.actor.n_actors
         ctx = mp.get_context("spawn")
@@ -164,7 +210,7 @@ class ActorPool:
         eps = actor_epsilons(n, cfg.actor.eps_base, cfg.actor.eps_alpha)
         self.procs = [
             ctx.Process(
-                target=_worker_main,
+                target=worker_fn or _worker_main,
                 args=(i, cfg, model_spec, self.chunk_queue,
                       self.param_queues[i], self.stat_queue, self.stop_event,
                       float(eps[i]), chunk_transitions),
@@ -190,22 +236,29 @@ class ActorPool:
                 else:
                     os.environ[k] = v
 
-    def cleanup(self) -> None:
+    def cleanup(self, grace_seconds: float = 10.0) -> None:
         """Stop workers (reference ``BatchRecorder.cleanup``,
-        ``batchrecorder.py:148-152``)."""
+        ``batchrecorder.py:148-152``).
+
+        The chunk queue is drained CONTINUOUSLY while joining — a single
+        pre-join drain would race with workers refilling it (a worker can be
+        mid-``put`` or produce one more chunk before seeing the stop event)
+        and the subsequent ``terminate()`` could kill a process inside
+        ``Queue.put``, corrupting the queue's shared pipe."""
         self.stop_event.set()
-        # unblock workers stuck on a full chunk queue
-        try:
-            while True:
-                self.chunk_queue.get_nowait()
-        except queue_lib.Empty:
-            pass
-        for p in self.procs:
+        deadline = time.monotonic() + grace_seconds
+        pending = list(self.procs)
+        while pending and time.monotonic() < deadline:
+            try:                       # keep unblocking producers mid-put
+                while True:
+                    self.chunk_queue.get_nowait()
+            except queue_lib.Empty:
+                pass
+            pending = [p for p in pending if (p.join(timeout=0.1), p)[1]
+                       .is_alive()]
+        for p in pending:              # unresponsive after the grace window
+            p.terminate()
             p.join(timeout=5)
-        for p in self.procs:
-            if p.is_alive():
-                p.terminate()
-                p.join(timeout=5)
         # Detach queue feeder threads: a dead child never drains its pipe, and
         # the default atexit join would hang the parent forever.
         for q in [self.chunk_queue, self.stat_queue, *self.param_queues]:

@@ -20,9 +20,20 @@ from typing import Any, Sequence
 class ReplayConfig:
     """Prioritized replay hyperparameters (reference: arguments.py:41-50)."""
 
-    capacity: int = 2 ** 21          # reference buffer 2e6, rounded to a power of 2
+    # PER-CHIP transition capacity.  The reference's single buffer holds 2e6
+    # transitions on a 128GB replay host (arguments.py:45-46); here replay is
+    # HBM-resident and SHARDED over the dp mesh, so per-chip capacity stays
+    # modest (2**19 ~ 524k transitions ~ 4.1 GiB of 84x84 frames) and an
+    # 8-chip slice holds 2**22 ~ 4.2M transitions total — above reference
+    # parity without overflowing any one chip's 16GB HBM.
+    capacity: int = 2 ** 19
     alpha: float = 0.6               # priority exponent
     beta: float = 0.4                # IS-weight exponent (annealed toward 1 by drivers)
+    # Transitions over which beta anneals linearly to 1.  A fixed horizon —
+    # NOT derived from warmup, which CI configs shrink to nothing (full IS
+    # correction against a tiny fresh buffer is high-variance and was
+    # destabilizing the concurrent pipeline's learning).
+    beta_anneal: int = 500_000
     warmup: int = 50_000             # learner gated until this many transitions (arguments.py:47-48)
     # Clamp floor for priorities entering the sum/min trees (pre-alpha).  The
     # reference's ADDITIVE 1e-6 on |td| (utils.py:77, memory.py:464) stays
@@ -31,6 +42,9 @@ class ReplayConfig:
     # TPU knobs
     device_resident: bool = True     # HBM struct-of-arrays vs. host (C++/numpy) buffer
     frame_pool: bool = False         # dedup frame-pool storage layout for stacked pixels
+    # Drivers refuse to allocate a replay shard whose estimated footprint
+    # exceeds this (leaving headroom for params/activations on a 16GB chip).
+    hbm_budget_gb: float = 12.0
 
     def __post_init__(self) -> None:
         if self.capacity <= 0 or self.capacity & (self.capacity - 1):

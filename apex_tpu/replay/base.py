@@ -45,3 +45,18 @@ class PERMethods:
     def _to_tree_priority(self, priorities: jax.Array) -> jax.Array:
         p = jnp.maximum(priorities.astype(jnp.float32), self.eps)
         return p ** self.alpha
+
+
+def check_hbm_budget(estimated_bytes: int, budget_gb: float,
+                     what: str, capacity: int) -> None:
+    """Refuse to allocate a replay shard over the chip budget — an
+    actionable error instead of an opaque XLA OOM mid-run.  Every driver
+    construction path calls this before ``init``."""
+    budget = int(budget_gb * 2 ** 30)
+    if estimated_bytes > budget:
+        raise ValueError(
+            f"{what} would need ~{estimated_bytes / 2**30:.1f} GiB HBM, "
+            f"over the {budget_gb:.1f} GiB budget (replay.hbm_budget_gb). "
+            f"Shrink replay.capacity (currently {capacity}) or raise the "
+            f"budget; multi-chip slices scale total capacity by the dp "
+            f"degree, so per-chip capacity stays modest.")

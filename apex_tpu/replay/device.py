@@ -64,6 +64,16 @@ class DeviceReplay(PERMethods):
 
     # -- construction ------------------------------------------------------
 
+    def hbm_bytes(self, example_item: Any) -> int:
+        """Estimated HBM footprint of one shard's :class:`ReplayState` for
+        this item pytree (drivers check vs the chip budget pre-alloc)."""
+        import numpy as np
+        per_item = sum(
+            int(np.prod(jnp.shape(x))) * np.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(example_item))
+        tree_bytes = 2 * (2 * self.capacity) * 4
+        return self.capacity * per_item + tree_bytes
+
     def init(self, example_item: Any) -> ReplayState:
         """Allocate zeroed storage shaped like one transition pytree."""
         storage = jax.tree.map(

@@ -101,6 +101,23 @@ class FramePoolReplay(PERMethods):
     def __post_init__(self):
         tree_ops._check_capacity(self.capacity)
         tree_ops._check_capacity(self.f_capacity)
+        if self.f_capacity < self.frame_stack:
+            raise ValueError(
+                f"frame_capacity={self.f_capacity} cannot hold one "
+                f"{self.frame_stack}-frame stack")
+
+    def hbm_bytes(self) -> int:
+        """Estimated HBM footprint of one shard's :class:`FramePoolState` —
+        drivers validate this against the chip budget BEFORE allocating so a
+        mis-sized config fails with an actionable error instead of an opaque
+        XLA OOM."""
+        c, s = self.capacity, self.frame_stack
+        frame_bytes = (self.f_capacity * self.frame_dim
+                       * jnp.dtype(self.frame_dtype).itemsize)
+        # action/reward/discount/frame_epoch i32|f32 + 2 id tables + 2 trees
+        per_trans = 4 * 4 + 2 * 4 * s
+        tree_bytes = 2 * (2 * c) * 4
+        return frame_bytes + c * per_trans + tree_bytes
 
     @property
     def f_capacity(self) -> int:
@@ -151,6 +168,24 @@ class FramePoolReplay(PERMethods):
         kf = chunk["frames"].shape[0]
         k = priorities.shape[0]
         f, c = self.f_capacity, self.capacity
+        # Shape validation runs at trace time (shapes are static under jit).
+        # Oversized chunks would make the duplicate-write padding invariant
+        # silently clobber live ring entries — reject them loudly instead.
+        if kf > f:
+            raise ValueError(
+                f"chunk carries {kf} frame rows > frame_capacity={f}")
+        if k > c:
+            raise ValueError(
+                f"chunk carries {k} transition rows > capacity={c}")
+        if chunk["frames"].shape[1] != self.frame_dim:
+            raise ValueError(
+                f"chunk frame_dim {chunk['frames'].shape[1]} != spec "
+                f"frame_dim {self.frame_dim}")
+        for ref in ("obs_ref", "next_ref"):
+            if tuple(chunk[ref].shape) != (k, self.frame_stack):
+                raise ValueError(
+                    f"chunk {ref} shape {tuple(chunk[ref].shape)} != "
+                    f"({k}, {self.frame_stack})")
         fpos = state.f_epoch % f
 
         frow = jnp.minimum(jnp.arange(kf, dtype=jnp.int32),

@@ -37,21 +37,201 @@ from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
                                     unstacked_env_spec)
 from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
 from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.training.checkpoint import (CheckpointableTrainer,
+                                          Checkpointer)
 from apex_tpu.training.learner import LearnerCore
 from apex_tpu.training.state import create_train_state
 from apex_tpu.utils.metrics import MetricLogger, RateCounter
 from apex_tpu.utils.seeding import set_global_seeds
 
 
-class ApexTrainer:
+def dqn_env_specs(cfg: ApexConfig):
+    """(model_spec, frame_shape, frame_dtype, frame_stack) from a probe env
+    — shared by the driver, the multi-host actor role, and the evaluator."""
+    probe = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
+                     stack_frames=False)
+    frame_shape, frame_dtype, frame_stack = unstacked_env_spec(probe, cfg.env)
+    model_spec = dict(
+        num_actions=num_actions(probe),
+        obs_is_image=len(frame_shape) == 3,
+        compute_dtype=jnp.dtype(cfg.learner.compute_dtype),
+        scale_uint8=np.dtype(frame_dtype) == np.uint8)
+    probe.close()
+    return model_spec, frame_shape, frame_dtype, frame_stack
+
+
+def dqn_model_spec(cfg: ApexConfig) -> dict:
+    return dqn_env_specs(cfg)[0]
+
+
+class ConcurrentTrainer(CheckpointableTrainer):
+    """The concurrent learner loop shared by every distributed family
+    (Ape-X DQN, Ape-X AQL): drain worker chunk messages, fuse ingest+train,
+    enforce the replay-ratio band, publish versioned params, checkpoint.
+
+    Chunk messages are family-agnostic dicts:
+    ``{"payload": <ingest pytree>, "priorities": f32[K], "n_trans": int}`` —
+    the payload goes straight into the family's fused step.
+
+    Subclasses construct: ``cfg, key, pool, replay, replay_state,
+    train_state, core, _fused, _train, _ingest, log, steps_rate,
+    frames_rate, ingested, param_version, checkpointer`` and the replay-ratio
+    knobs (see :class:`ApexTrainer` for the reference wiring).
+    """
+
+    # -- param plane -------------------------------------------------------
+
+    def _publish(self) -> None:
+        self.param_version += 1
+        host_params = jax.device_get(self.train_state.params)
+        self.pool.publish_params(self.param_version, host_params)
+
+    # -- main loop ---------------------------------------------------------
+
+    def train(self, total_steps: int, max_seconds: float = 3600.0,
+              log_every: int = 200):
+        """Run ``total_steps`` MORE learner updates (or until the wall
+        clock).  On a restored trainer the step counter continues from the
+        checkpoint — same resume contract as the single-process drivers."""
+        cfg = self.cfg
+        pool = self.pool
+        target_steps = self.steps_rate.total + total_steps
+        pool.start()
+        try:
+            self._publish()
+            last_publish = time.monotonic()
+            t_end = last_publish + max_seconds
+            episode_idx = 0
+            last_save = last_log = -1
+            metrics = None      # no update has run yet this call (a restored
+                                # trainer can hit the log gate before one)
+
+            while self.steps_rate.total < target_steps:
+                now = time.monotonic()
+                if now > t_end:
+                    break
+                warm = self.ingested >= cfg.replay.warmup
+                consumed = self.steps_rate.total * self.core.batch_size
+                budget = (float("inf") if self.train_ratio is None
+                          else self.ingested * self.train_ratio
+                          / self.core.batch_size)
+                # Replay-ratio floor: learner behind -> pause draining so the
+                # bounded chunk queue backpressures the actor fleet.
+                behind = (warm and self.min_train_ratio is not None
+                          and consumed < self.ingested * self.min_train_ratio)
+
+                msg = None
+                if not behind:
+                    msgs = pool.poll_chunks(1, timeout=0 if warm else 0.05)
+                    if msgs:
+                        msg = msgs[0]
+
+                if msg is not None:
+                    prios = jnp.asarray(msg["priorities"])
+                    n_new = int(msg["n_trans"])
+                    payload = msg["payload"]
+                    # The replay-ratio cap applies on the chunk path too: an
+                    # over-budget learner ingests WITHOUT the fused train
+                    # half, so the documented ``train_ratio`` really is the
+                    # ceiling (ingesting raises the budget for later steps).
+                    if warm and self.steps_rate.total < budget:
+                        self.key, k = jax.random.split(self.key)
+                        self.train_state, self.replay_state, metrics = \
+                            self._fused(self.train_state, self.replay_state,
+                                        payload, prios, k,
+                                        jnp.float32(self._beta()))
+                        self.steps_rate.tick()
+                    else:
+                        self.replay_state = self._ingest(
+                            self.replay_state, payload, prios)
+                    self.ingested += n_new
+                    self.frames_rate.tick(n_new)
+                elif warm and self.steps_rate.total < budget:
+                    self.key, k = jax.random.split(self.key)
+                    self.train_state, self.replay_state, metrics = \
+                        self._train(self.train_state, self.replay_state, k,
+                                    jnp.float32(self._beta()))
+                    self.steps_rate.tick()
+                elif warm:
+                    time.sleep(0.002)   # replay-ratio cap reached
+
+                steps = self.steps_rate.total
+                if (self.checkpointer is not None and steps
+                        and steps % cfg.learner.save_interval == 0
+                        and steps != last_save):
+                    self.save_checkpoint()
+                    last_save = steps
+                # Pre-first-step republish (slow cadence) is needed only for
+                # socket pools: a TCP subscriber that joined after the
+                # initial publish would otherwise never receive params
+                # (PUB/SUB has no replay — the zmq slow-joiner race) and an
+                # actor fleet without params produces no chunks: deadlock.
+                # mp pools have pre-existing queues, so the initial publish
+                # cannot be lost and warmup republishes would only burn the
+                # ingest thread on param serialization.
+                if steps:
+                    due = (now - last_publish >= self.publish_min_seconds
+                           and (steps % cfg.learner.publish_interval == 0
+                                or now - last_publish
+                                > 10 * self.publish_min_seconds))
+                else:
+                    due = (getattr(pool, "needs_warmup_republish", False)
+                           and now - last_publish
+                           > 10 * self.publish_min_seconds)
+                if due:
+                    self._publish()
+                    last_publish = now
+
+                for stat in pool.poll_stats():
+                    self.log.scalars(
+                        {"episode_reward": stat.reward,
+                         "episode_length": stat.length,
+                         "actor_id": stat.actor_id}, episode_idx)
+                    episode_idx += 1
+
+                if warm and steps and metrics is not None \
+                        and steps % log_every == 0 and steps != last_log:
+                    self.log.scalars(
+                        {k: float(v) for k, v in metrics.items()}
+                        | {"bps": self.steps_rate.rate,
+                           "fps": self.frames_rate.rate,
+                           "param_version": self.param_version,
+                           "ingested": self.ingested}, steps)
+                    last_log = steps
+        finally:
+            pool.cleanup()
+        return self
+
+    def _beta(self) -> float:
+        frac = min(1.0, self.ingested / max(1, self.cfg.replay.beta_anneal))
+        return self.cfg.replay.beta + (1.0 - self.cfg.replay.beta) * frac
+
+    # -- checkpointing (A4): format/IO in CheckpointableTrainer ------------
+    # (restore note: the actor fleet re-syncs from the first post-restore
+    # publish — actors are stateless consumers)
+
+    def _counters(self) -> dict:
+        return dict(ingested=self.ingested, steps=self.steps_rate.total,
+                    param_version=self.param_version)
+
+    def _apply_counters(self, meta: dict) -> None:
+        self.ingested = meta["ingested"]
+        self.steps_rate.total = meta["steps"]
+        self.param_version = meta["param_version"]
+
+
+class ApexTrainer(ConcurrentTrainer):
     """train_DQN-equivalent driver (``ApeX.py:13-82``), frame-pool edition."""
 
     def __init__(self, config: ApexConfig | None = None,
                  logdir: str | None = None, verbose: bool = False,
                  publish_min_seconds: float = 0.2,
                  train_ratio: float | None = None,
-                 min_train_ratio: float | None = None):
+                 min_train_ratio: float | None = None,
+                 checkpoint_dir: str | None = None,
+                 pool=None):
         """Replay-ratio control (samples consumed per transition ingested):
 
         ``train_ratio`` caps the ratio — the learner idles when it has
@@ -74,22 +254,16 @@ class ApexTrainer:
                 and min_train_ratio > train_ratio):
             raise ValueError("min_train_ratio must be <= train_ratio")
 
-        probe = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
-                         stack_frames=False)
-        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
-            probe, cfg.env)
-        self.model_spec = dict(
-            num_actions=num_actions(probe),
-            obs_is_image=len(frame_shape) == 3,
-            compute_dtype=jnp.dtype(cfg.learner.compute_dtype),
-            scale_uint8=np.dtype(frame_dtype) == np.uint8)
-        probe.close()
+        self.model_spec, frame_shape, frame_dtype, frame_stack = \
+            dqn_env_specs(cfg)
 
         self.model = DuelingDQN(**self.model_spec)
         self.replay = FramePoolReplay(
             capacity=cfg.replay.capacity, frame_shape=frame_shape,
             frame_stack=frame_stack, frame_dtype=np.dtype(frame_dtype).name,
             alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+        check_hbm_budget(self.replay.hbm_bytes(), cfg.replay.hbm_budget_gb,
+                         "frame-pool replay", cfg.replay.capacity)
         lc = cfg.learner
         optimizer = make_optimizer(
             lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
@@ -109,108 +283,17 @@ class ApexTrainer:
         self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_policy_fn(self.model))
 
-        self.pool = ActorPool(cfg, self.model_spec,
-                              chunk_transitions=cfg.actor.send_interval)
+        # pool injection: the multi-host learner passes a socket-backed
+        # RemotePool; default is the in-host process pool
+        self.pool = pool if pool is not None else ActorPool(
+            cfg, self.model_spec, chunk_transitions=cfg.actor.send_interval)
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()
         self.ingested = 0
         self.param_version = 0
-
-    # -- param plane -------------------------------------------------------
-
-    def _publish(self) -> None:
-        self.param_version += 1
-        host_params = jax.device_get(self.train_state.params)
-        self.pool.publish_params(self.param_version, host_params)
-
-    # -- main loop ---------------------------------------------------------
-
-    def train(self, total_steps: int, max_seconds: float = 3600.0,
-              log_every: int = 200):
-        """Run until ``total_steps`` learner updates (or the wall clock)."""
-        cfg = self.cfg
-        pool = self.pool
-        pool.start()
-        try:
-            self._publish()
-            last_publish = time.monotonic()
-            t_end = last_publish + max_seconds
-            episode_idx = 0
-
-            while self.steps_rate.total < total_steps:
-                now = time.monotonic()
-                if now > t_end:
-                    break
-                warm = self.ingested >= cfg.replay.warmup
-                consumed = self.steps_rate.total * self.core.batch_size
-                budget = (float("inf") if self.train_ratio is None
-                          else self.ingested * self.train_ratio
-                          / self.core.batch_size)
-                # Replay-ratio floor: learner behind -> pause draining so the
-                # bounded chunk queue backpressures the actor fleet.
-                behind = (warm and self.min_train_ratio is not None
-                          and consumed < self.ingested * self.min_train_ratio)
-
-                chunk = None
-                if not behind:
-                    chunks = pool.poll_chunks(1, timeout=0 if warm else 0.05)
-                    if chunks:
-                        chunk = chunks[0]
-
-                if chunk is not None:
-                    prios = jnp.asarray(chunk.pop("priorities"))
-                    n_new = int(chunk["n_trans"])
-                    if warm:
-                        self.key, k = jax.random.split(self.key)
-                        self.train_state, self.replay_state, metrics = \
-                            self._fused(self.train_state, self.replay_state,
-                                        chunk, prios, k,
-                                        jnp.float32(self._beta()))
-                        self.steps_rate.tick()
-                    else:
-                        self.replay_state = self._ingest(
-                            self.replay_state, chunk, prios)
-                    self.ingested += n_new
-                    self.frames_rate.tick(n_new)
-                elif warm and self.steps_rate.total < budget:
-                    self.key, k = jax.random.split(self.key)
-                    self.train_state, self.replay_state, metrics = \
-                        self._train(self.train_state, self.replay_state, k,
-                                    jnp.float32(self._beta()))
-                    self.steps_rate.tick()
-                elif warm:
-                    time.sleep(0.002)   # replay-ratio cap reached
-
-                steps = self.steps_rate.total
-                if steps and (steps % cfg.learner.publish_interval == 0
-                              or now - last_publish
-                              > 10 * self.publish_min_seconds) \
-                        and now - last_publish >= self.publish_min_seconds:
-                    self._publish()
-                    last_publish = now
-
-                for stat in pool.poll_stats():
-                    self.log.scalars(
-                        {"episode_reward": stat.reward,
-                         "episode_length": stat.length,
-                         "actor_id": stat.actor_id}, episode_idx)
-                    episode_idx += 1
-
-                if warm and steps and steps % log_every == 0:
-                    self.log.scalars(
-                        {k: float(v) for k, v in metrics.items()}
-                        | {"bps": self.steps_rate.rate,
-                           "fps": self.frames_rate.rate,
-                           "param_version": self.param_version,
-                           "ingested": self.ingested}, steps)
-        finally:
-            pool.cleanup()
-        return self
-
-    def _beta(self) -> float:
-        frac = min(1.0, self.ingested / max(1, 10 * self.cfg.replay.warmup))
-        return self.cfg.replay.beta + (1.0 - self.cfg.replay.beta) * frac
+        self.checkpointer = (Checkpointer(checkpoint_dir)
+                             if checkpoint_dir else None)
 
     # -- evaluation --------------------------------------------------------
 

@@ -27,6 +27,8 @@ from apex_tpu.envs.registry import make_env, make_eval_env, num_actions
 from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
 from apex_tpu.replay.nstep import NStepAccumulator
 from apex_tpu.training import learner as learner_lib
+from apex_tpu.training.checkpoint import (CheckpointableTrainer,
+                                          Checkpointer)
 from apex_tpu.utils.metrics import MetricLogger, RateCounter
 from apex_tpu.utils.seeding import set_global_seeds
 
@@ -55,23 +57,24 @@ class BetaSchedule:
         return min(1.0, self.start + (1.0 - self.start) * frame / self.frames)
 
 
-class DQNTrainer:
+class DQNTrainer(CheckpointableTrainer):
     """train_DQN equivalent (``DQN.py:15-75``)."""
 
     def __init__(self, config: ApexConfig | None = None,
                  logdir: str | None = None, verbose: bool = False,
-                 train_every: int = 1):
+                 train_every: int = 1, checkpoint_dir: str | None = None):
         self.cfg = config or ApexConfig()
         self.key = set_global_seeds(self.cfg.env.seed)
         self.env = make_env(self.cfg.env.env_id, self.cfg.env,
                             seed=self.cfg.env.seed,
                             max_episode_steps=self.cfg.actor.max_episode_length)
         obs_shape = self.env.observation_space.shape
-        self.model = DuelingDQN(
+        self.model_spec = dict(
             num_actions=num_actions(self.env),
             obs_is_image=len(obs_shape) == 3,
             compute_dtype=jnp.dtype(self.cfg.learner.compute_dtype),
             scale_uint8=self.env.observation_space.dtype == np.uint8)
+        self.model = DuelingDQN(**self.model_spec)
 
         lc = self.cfg.learner
         example_obs = jnp.zeros((1,) + obs_shape,
@@ -85,7 +88,8 @@ class DQNTrainer:
                 rmsprop_decay=lc.rmsprop_decay, rmsprop_eps=lc.rmsprop_eps,
                 rmsprop_centered=lc.rmsprop_centered,
                 replay_eps=self.cfg.replay.eps,
-                target_update_interval=lc.target_update_interval)
+                target_update_interval=lc.target_update_interval,
+                hbm_budget_gb=self.cfg.replay.hbm_budget_gb)
         self._train_step = self.core.jit_train_step()
         self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_policy_fn(self.model))
@@ -101,6 +105,19 @@ class DQNTrainer:
         self.ingested = 0
         self._pending: list[tuple[dict, np.ndarray]] = []
         self._pending_count = 0
+        self.checkpointer = (Checkpointer(checkpoint_dir)
+                             if checkpoint_dir else None)
+
+    # -- checkpointing (A4): format/IO in CheckpointableTrainer ------------
+
+    def _counters(self) -> dict:
+        return dict(ingested=self.ingested, frames=self.frames_rate.total,
+                    steps=self.steps_rate.total)
+
+    def _apply_counters(self, meta: dict) -> None:
+        self.ingested = meta["ingested"]
+        self.frames_rate.total = meta["frames"]
+        self.steps_rate.total = meta["steps"]
 
     # -- data plane --------------------------------------------------------
 
@@ -131,11 +148,15 @@ class DQNTrainer:
     # -- main loop ---------------------------------------------------------
 
     def train(self, total_frames: int, log_every: int = 1000):
+        """Run ``total_frames`` MORE env frames.  On a restored trainer the
+        frame counter (and with it the epsilon/beta schedules) continues
+        from the checkpoint instead of rewinding to frame 1."""
         cfg = self.cfg
         obs, _ = self.env.reset(seed=cfg.env.seed)
         episode_reward, episode_len, episode_idx = 0.0, 0, 0
+        start = self.frames_rate.total
 
-        for frame in range(1, total_frames + 1):
+        for frame in range(start + 1, start + total_frames + 1):
             eps = self.epsilon(frame)
             self.key, act_key = jax.random.split(self.key)
             obs_np = np.asarray(obs)
@@ -173,6 +194,9 @@ class DQNTrainer:
                     self._train_step(self.train_state, self.replay_state,
                                      step_key, jnp.float32(self.beta(frame)))
                 self.steps_rate.tick()
+                if (self.checkpointer is not None and self.steps_rate.total
+                        % cfg.learner.save_interval == 0):
+                    self.save_checkpoint()
                 # host-side counter for the log gate: reading
                 # train_state.step would sync the async device step
                 if self.steps_rate.total % log_every == 0:

@@ -141,7 +141,8 @@ def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
                   rmsprop_decay: float = 0.95, rmsprop_eps: float = 1.5e-7,
                   rmsprop_centered: bool = True, replay_eps: float = 1e-6,
                   target_update_interval: int = 2500,
-                  obs_dtype=None) -> tuple[LearnerCore, TrainState, ReplayState]:
+                  obs_dtype=None, hbm_budget_gb: float | None = None
+                  ) -> tuple[LearnerCore, TrainState, ReplayState]:
     """Convenience constructor used by drivers and benches."""
     optimizer = make_optimizer(lr=lr, decay=rmsprop_decay, eps=rmsprop_eps,
                                centered=rmsprop_centered,
@@ -158,6 +159,10 @@ def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
                            obs_dtype or example_obs.dtype),
         discount=jnp.float32(0),
     )
+    if hbm_budget_gb is not None:
+        from apex_tpu.replay.base import check_hbm_budget
+        check_hbm_budget(replay.hbm_bytes(example_item), hbm_budget_gb,
+                         "replay (stacked obs storage)", replay_capacity)
     replay_state = replay.init(example_item)
     core = LearnerCore(apply_fn=model.apply, replay=replay,
                        optimizer=optimizer, batch_size=batch_size,

@@ -35,19 +35,37 @@ def test_apex_pipeline_mechanics():
     assert np.isfinite(score)
 
 
+def test_trainer_rejects_replay_over_hbm_budget():
+    """Mis-sized replay configs must fail at construction with an
+    actionable error, not an opaque XLA OOM mid-run."""
+    import dataclasses
+
+    cfg = small_test_config()
+    cfg = cfg.replace(replay=dataclasses.replace(cfg.replay,
+                                                 hbm_budget_gb=1e-6))
+    with pytest.raises(ValueError, match="HBM"):
+        ApexTrainer(cfg)
+
+
 def test_apex_learns_cartpole():
     """The concurrent pipeline must actually learn: greedy eval clearly
-    beats random play (~22/episode) within a small budget.  Actor/learner
-    interleaving is nondeterministic, so allow one retry before declaring
-    the pipeline broken (each attempt trains from scratch)."""
-    scores = []
-    for attempt in range(2):
-        cfg = small_test_config(capacity=8192, batch_size=64, n_actors=3)
-        trainer = ApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0)
-        trainer.train(total_steps=5000, max_seconds=300)
-        scores.append(trainer.evaluate(episodes=5, epsilon=0.0,
-                                       max_steps=500))
-        if scores[-1] > 40.0:
-            return
-    raise AssertionError(f"eval rewards {scores} never exceeded 40: "
-                         "pipeline not learning")
+    beats random play (~22/episode) within a small budget.  No retries —
+    learning must be robust to actor/learner interleaving (epsilon anneal
+    keeps early near-greedy actors exploring; the replay-ratio band keeps
+    data and compute in step whatever the host's core count)."""
+    import dataclasses
+
+    cfg = small_test_config(capacity=8192, batch_size=64, n_actors=3)
+    # The reference ladder (eps_alpha=7, batchrecorder.py:121) is tuned for
+    # ~200-actor fleets; with 3 actors it leaves two of them near-greedy
+    # from step 0, which reliably collapses learning (verified both ways).
+    # Small fleets get a gentler ladder + an exploration anneal.
+    cfg = cfg.replace(actor=dataclasses.replace(
+        cfg.actor, eps_anneal_steps=1500, eps_alpha=3.0))
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05,
+                          train_ratio=8.0, min_train_ratio=1.0)
+    # generous wall-clock ceiling: under CPU contention the step budget —
+    # not the clock — must decide when training is done
+    trainer.train(total_steps=6000, max_seconds=900)
+    score = trainer.evaluate(episodes=5, epsilon=0.0, max_steps=500)
+    assert score > 40.0, f"eval reward {score} <= 40: pipeline not learning"

@@ -280,3 +280,57 @@ def test_learner_core_end_to_end_with_frame_pool(key):
     assert int(ts2.step) == 1
     assert np.isfinite(float(metrics["loss"]))
     assert float(metrics["grad_norm"]) > 0
+
+
+# -- config/shape validation (fail loudly, never corrupt the ring) ---------
+
+def _valid_chunk(pool, k, kf, rng):
+    s = pool.frame_stack
+    return dict(
+        frames=rng.integers(0, 255, (kf, pool.frame_dim)).astype(np.uint8),
+        n_frames=np.int32(kf), n_trans=np.int32(k),
+        action=np.zeros(k, np.int32), reward=np.zeros(k, np.float32),
+        discount=np.zeros(k, np.float32),
+        obs_ref=np.zeros((k, s), np.int32),
+        next_ref=np.zeros((k, s), np.int32))
+
+
+def test_add_rejects_oversized_and_misshapen_chunks():
+    pool = FramePoolReplay(capacity=8, frame_capacity=16,
+                           frame_shape=SHAPE, frame_stack=2)
+    state = pool.init()
+    rng = np.random.default_rng(0)
+    prios = np.ones(4, np.float32)
+
+    with pytest.raises(ValueError, match="frame rows"):
+        pool.add(state, _valid_chunk(pool, 4, 32, rng), prios)
+    with pytest.raises(ValueError, match="transition rows"):
+        pool.add(state, _valid_chunk(pool, 16, 8, rng),
+                 np.ones(16, np.float32))
+    bad = _valid_chunk(pool, 4, 8, rng)
+    bad["frames"] = bad["frames"][:, :-1]
+    with pytest.raises(ValueError, match="frame_dim"):
+        pool.add(state, bad, prios)
+    bad = _valid_chunk(pool, 4, 8, rng)
+    bad["obs_ref"] = np.zeros((4, 3), np.int32)
+    with pytest.raises(ValueError, match="obs_ref"):
+        pool.add(state, bad, prios)
+    # the happy path still works after all those rejections
+    state = pool.add(state, _valid_chunk(pool, 4, 8, rng), prios)
+    assert int(state.size) == 4
+
+
+def test_spec_rejects_ring_smaller_than_one_stack():
+    with pytest.raises(ValueError, match="stack"):
+        FramePoolReplay(capacity=8, frame_capacity=2, frame_shape=SHAPE,
+                        frame_stack=4)
+
+
+def test_hbm_bytes_estimate_matches_allocated_state():
+    pool = FramePoolReplay(capacity=64, frame_shape=SHAPE, frame_stack=4)
+    state = pool.init()
+    actual = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(state))
+    est = pool.hbm_bytes()
+    # estimate covers everything but scalar cursors (a few bytes)
+    assert abs(est - actual) / actual < 0.01
