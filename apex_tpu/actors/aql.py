@@ -1,0 +1,72 @@
+"""AQL actor worker family (reference ``batchrecoder_AQL.py``, C9).
+
+Plugs the proposal+Q acting step into the family-agnostic
+:func:`apex_tpu.actors.pool.worker_loop` — same continuous exploration,
+conflating param queues, bounded chunk backpressure, and epsilon ladder as
+the DQN family — shipping 1-step transitions that carry the ``a_mu``
+candidate set (``memory.py:364-391``) with acting-time TD priorities.
+
+The reference's AQL recorder re-adds each transition ``len(state)`` times by
+a loop quirk (``batchrecoder_AQL.py:121-123``); here every transition ships
+exactly once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig
+
+
+class AQLWorkerFamily:
+    """AQL acting/recording hooks for ``worker_loop``."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seed: int,
+                 chunk_transitions: int):
+        import jax
+
+        from apex_tpu.envs.registry import make_env
+        from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
+        from apex_tpu.training.aql import AQLTransitionBuilder
+
+        self.seed = seed
+        self.env = make_env(cfg.env.env_id, cfg.env, seed=seed,
+                            max_episode_steps=cfg.actor.max_episode_length)
+        self.policy = jax.jit(make_aql_policy_fn(AQLNetwork(**model_spec)))
+        self.builder = AQLTransitionBuilder(cfg.learner.gamma)
+        self.chunk_transitions = chunk_transitions
+
+    def begin_episode(self, obs) -> None:
+        pass                        # 1-step transitions: no episode state
+
+    def step(self, params, obs, epsilon: float, key):
+        import jax.numpy as jnp
+        obs_np = np.asarray(obs)
+        actions, idx, a_mu, q = self.policy(params, obs_np[None],
+                                            jnp.float32(epsilon), key)
+        next_obs, reward, term, trunc, _ = self.env.step(
+            np.asarray(actions[0]))
+        self.builder.add_step(obs_np, int(idx[0]), float(reward),
+                              np.asarray(next_obs), np.asarray(a_mu[0]),
+                              np.asarray(q[0]), bool(term), bool(trunc))
+        return next_obs, float(reward), bool(term), bool(trunc)
+
+    def poll_msgs(self) -> list[dict]:
+        out = []
+        while len(self.builder) >= self.chunk_transitions:
+            batch, prios = self.builder.drain(self.chunk_transitions)
+            out.append({"payload": batch, "priorities": prios,
+                        "n_trans": len(prios)})
+        return out
+
+
+def aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                    chunk_queue, param_queue, stat_queue, stop_event,
+                    epsilon: float, chunk_transitions: int) -> None:
+    from apex_tpu.actors.pool import worker_loop
+
+    family = AQLWorkerFamily(cfg, model_spec,
+                             seed=cfg.env.seed + 1000 * (actor_id + 1),
+                             chunk_transitions=chunk_transitions)
+    worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
+                stop_event, epsilon)

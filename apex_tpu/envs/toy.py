@@ -75,6 +75,43 @@ class CartPoleEnv(gym.Env):
         return (self._state.astype(np.float32), 1.0, terminated, truncated, {})
 
 
+class ContinuousNavEnv(gym.Env):
+    """Continuous-action navigation: drive a point to the origin.
+
+    The CI-scale continuous-control task for AQL (the reference exercises
+    AQL on gym Box-action tasks, ``model.py:174-176``).  Observation is the
+    agent's position in ``[-2, 2]^dim``; the action is a velocity in
+    ``[-1, 1]^dim`` scaled by 0.2; reward is ``-|position|_2`` per step, so
+    an optimal policy proposes actions pointing at the origin and episode
+    return climbs toward 0.  Episodes truncate at ``max_episode_steps``.
+    """
+
+    metadata: dict = {}
+
+    def __init__(self, dim: int = 2, max_episode_steps: int = 30,
+                 step_scale: float = 0.2):
+        self.dim, self._max_steps, self._scale = dim, max_episode_steps, \
+            step_scale
+        self.observation_space = gym.spaces.Box(-2.0, 2.0, (dim,), np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (dim,), np.float32)
+        self._pos = np.zeros(dim, np.float64)
+        self._steps = 0
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        self._pos = self.np_random.uniform(-2.0, 2.0, size=self.dim)
+        self._steps = 0
+        return self._pos.astype(np.float32), {}
+
+    def step(self, action):
+        a = np.clip(np.asarray(action, np.float64), -1.0, 1.0)
+        self._pos = np.clip(self._pos + self._scale * a, -2.0, 2.0)
+        self._steps += 1
+        reward = -float(np.linalg.norm(self._pos))
+        truncated = self._steps >= self._max_steps
+        return self._pos.astype(np.float32), reward, False, truncated, {}
+
+
 class CatchEnv(gym.Env):
     """Catch a falling ball with a paddle; pixel observations.
 

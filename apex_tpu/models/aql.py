@@ -1,0 +1,197 @@
+"""AQL — proposal-action Q-learning for continuous action spaces.
+
+Capability parity with the reference ``AQL``/``Q_Network``/``Proposal_Network``
+(``model.py:169-390``): Q-learning over a per-state CANDIDATE SET of actions —
+``uniform_sample`` draws from the action box plus ``propose_sample`` draws
+from a learned Gaussian proposal (fixed diagonal covariance ``action_var``,
+``model.py:365-369``) — scored by a Q head whose advantage MLP uses NoisyNet
+layers for exploration (``model.py:268-270``).  Acting = argmax over the
+candidate scores, epsilon-greedy over the candidate INDEX
+(``model.py:330-335``); the candidate set ``a_mu`` is stored with the
+transition so the learner re-scores the same set (``memory.py:364-391``).
+
+TPU-first redesign (not a port):
+
+* One flax module, one params tree; the proposal head lives under the
+  ``proposal`` scope so the two-optimizer split (``AQL.py:41-42``) is a pure
+  label function over the tree — no separate networks with copied trunks.
+* All sampling is functional: candidate draws use a ``'sample'`` PRNG
+  collection, NoisyDense noise a ``'noise'`` collection; there is no
+  ``reset_noise`` side effect — every ``apply`` with a fresh key IS the
+  reset (``AQL_dis.py:104-105`` semantics by construction).
+* Candidate scoring is one batched einsum-friendly pass over ``[B, T]``
+  pairs — the (state-embed, action-embed) tiling the reference does with
+  ``repeat``/``reshape`` (``model.py:294-320``) is a broadcast, no data
+  motion, and the ``[B*T, feat]`` matmuls land on the MXU.
+
+Discrete action spaces: the reference also routes discrete envs through AQL
+(Categorical proposal, ``model.py:370-376``); this framework covers discrete
+spaces with the purpose-built :class:`~apex_tpu.models.dueling.DuelingDQN`
+path instead — AQL here is the continuous-control family.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.models.dueling import orthogonal_init
+from apex_tpu.models.noisy import NoisyDense
+
+
+class AQLNetwork(nn.Module):
+    """Embedding trunk + proposal head + candidate-scoring Q head.
+
+    Attributes:
+      action_dim: dimensionality of the Box action space.
+      action_low/high: box bounds (uniform candidates are drawn here).
+      propose_sample/uniform_sample: candidate-set split (``model.py:170``).
+      action_var: fixed diagonal variance of the proposal Gaussian.
+      noisy_deterministic: mu-only NoisyDense (eval mode).
+    """
+
+    action_dim: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    propose_sample: int = 100
+    uniform_sample: int = 400
+    action_var: float = 0.25
+    obs_is_image: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+    scale_uint8: bool = False
+    noisy_deterministic: bool = False
+    trunk_features: Sequence[int] = (32, 64, 64)
+
+    @property
+    def total_sample(self) -> int:
+        return self.propose_sample + self.uniform_sample
+
+    def setup(self):
+        dt = self.compute_dtype
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, dtype=dt, kernel_init=orthogonal_init(),
+            bias_init=nn.initializers.zeros, name=name)
+        # state embedding feeding the proposal (model.py:283-287)
+        self.embed_hidden = dense(128, "embed_hidden")
+        # proposal head: embed -> mu (model.py:356-360); the "proposal"/
+        # "embed" scope prefixes are the two-optimizer split keys
+        # (ops.losses.aql_param_labels)
+        self.proposal_hidden = dense(128, "proposal_hidden")
+        self.proposal_mu = dense(self.action_dim, "proposal_mu")
+        # Q-side state feature (model.py:245-250: raw obs -> 64 -> 64)
+        self.q_feature1 = dense(64, "q_feature1")
+        self.q_feature2 = dense(64, "q_feature2")
+        # action embedding (model.py:252-259: A -> 128 -> 64)
+        self.action_embed1 = dense(128, "action_embed1")
+        self.action_embed2 = dense(64, "action_embed2")
+        # NoisyNet advantage scorer (model.py:268-270)
+        self.advantage1 = NoisyDense(64, deterministic=self.noisy_deterministic,
+                                     compute_dtype=dt, name="advantage1")
+        self.advantage2 = NoisyDense(1, deterministic=self.noisy_deterministic,
+                                     compute_dtype=dt, name="advantage2")
+
+    # -- pieces ------------------------------------------------------------
+
+    def _prep(self, obs: jax.Array) -> jax.Array:
+        dt = self.compute_dtype
+        if obs.dtype == jnp.uint8 and self.scale_uint8:
+            obs = obs.astype(dt) / jnp.asarray(255.0, dt)
+        else:
+            obs = obs.astype(dt)
+        if self.obs_is_image:
+            obs = obs.reshape((obs.shape[0], -1))
+        return obs
+
+    def embed(self, obs: jax.Array) -> jax.Array:
+        """128-d state embedding (``Q_Network.embedding_feature``)."""
+        return nn.relu(self.embed_hidden(self._prep(obs)))
+
+    def proposal_mean(self, obs: jax.Array) -> jax.Array:
+        """Gaussian mean of the proposal distribution, ``[B, A]``."""
+        h = nn.relu(self.proposal_hidden(self.embed(obs)))
+        return self.proposal_mu(h).astype(jnp.float32)
+
+    def propose(self, obs: jax.Array) -> jax.Array:
+        """Draw the candidate set ``a_mu [B, T, A]`` — uniform box samples
+        first, Gaussian proposals second (``model.py:361-369`` ordering).
+        Needs ``rngs={'sample': key}``."""
+        b = obs.shape[0]
+        mu = self.proposal_mean(obs)
+        key = self.make_rng("sample")
+        k_u, k_p = jax.random.split(key)
+        a_uniform = jax.random.uniform(
+            k_u, (b, self.uniform_sample, self.action_dim), jnp.float32,
+            self.action_low, self.action_high)
+        sigma = jnp.sqrt(jnp.float32(self.action_var))
+        a_prop = mu[:, None, :] + sigma * jax.random.normal(
+            k_p, (b, self.propose_sample, self.action_dim), jnp.float32)
+        return jnp.concatenate([a_uniform, a_prop], axis=1)
+
+    def score(self, obs: jax.Array, a_mu: jax.Array) -> jax.Array:
+        """Q-values of every candidate, ``[B, T]`` (``Q_Network.act`` tiling,
+        ``model.py:294-320``, as a broadcast).  Needs ``rngs={'noise': key}``
+        unless ``noisy_deterministic``."""
+        b, t, _ = a_mu.shape
+        qf = nn.relu(self.q_feature2(nn.relu(
+            self.q_feature1(self._prep(obs)))))              # [B, 64]
+        af = nn.relu(self.action_embed2(nn.relu(
+            self.action_embed1(a_mu.reshape(b * t, -1)))))   # [B*T, 64]
+        x = jnp.concatenate(
+            [af.reshape(b, t, -1),
+             jnp.broadcast_to(qf[:, None, :], (b, t, qf.shape[-1]))], axis=-1)
+        x = nn.relu(x).reshape(b * t, -1)
+        adv = self.advantage2(nn.relu(self.advantage1(x)))
+        return adv.reshape(b, t).astype(jnp.float32)
+
+    def __call__(self, obs: jax.Array, a_mu: jax.Array) -> jax.Array:
+        return self.score(obs, a_mu)
+
+    def full_init(self, obs: jax.Array, a_mu: jax.Array) -> jax.Array:
+        """Init entry touching every submodule (score alone would skip the
+        embed/proposal params).  ``model.init({'params', 'noise', 'sample'},
+        obs, a_mu, method=AQLNetwork.full_init)``."""
+        _ = self.propose(obs)
+        return self.score(obs, a_mu)
+
+    # -- log-density of the proposal (for the proposal loss) ---------------
+
+    def proposal_log_prob(self, obs: jax.Array,
+                          actions: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """``(log N(actions | mu(obs), action_var*I), entropy)`` per row.
+
+        With the covariance fixed (``model.py:364-365``) the entropy is a
+        constant — kept for parity with the reference's
+        ``-log_prob - lam*entropy`` objective (``AQL_dis.py:84-86``)."""
+        mu = self.proposal_mean(obs)
+        var = jnp.float32(self.action_var)
+        d = self.action_dim
+        log_prob = (-0.5 * jnp.sum((actions - mu) ** 2, axis=-1) / var
+                    - 0.5 * d * jnp.log(2 * jnp.pi * var))
+        entropy = 0.5 * d * (1.0 + jnp.log(2 * jnp.pi * var))
+        return log_prob, jnp.broadcast_to(entropy, log_prob.shape)
+
+
+def make_aql_policy_fn(model: AQLNetwork):
+    """Jittable acting step (``AQL.act``, ``model.py:198-205``): propose
+    candidates, score them, epsilon-greedy over the candidate index.
+    Returns ``(env_actions [B, A], idx [B], a_mu [B, T, A], q [B, T])`` —
+    the actor stores ``idx`` + ``a_mu`` so the learner re-scores the exact
+    candidate set."""
+
+    def policy(params, obs: jax.Array, epsilon: jax.Array, key: jax.Array):
+        k_sample, k_noise, k_eps, k_rand = jax.random.split(key, 4)
+        a_mu = model.apply(params, obs, method=AQLNetwork.propose,
+                           rngs={"sample": k_sample})
+        q = model.apply(params, obs, a_mu, rngs={"noise": k_noise})
+        greedy = q.argmax(axis=1)
+        rand = jax.random.randint(k_rand, greedy.shape, 0, model.total_sample)
+        explore = jax.random.uniform(k_eps, greedy.shape) < epsilon
+        idx = jnp.where(explore, rand, greedy)
+        actions = jnp.take_along_axis(
+            a_mu, idx[:, None, None], axis=1)[:, 0, :]
+        return actions, idx, a_mu, q
+
+    return policy

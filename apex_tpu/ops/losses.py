@@ -34,6 +34,16 @@ class TDOutput(NamedTuple):
     q_taken: jax.Array       # (B,) Q(s0, a0) — mean logged as learner/q
 
 
+class AQLOutput(NamedTuple):
+    loss: jax.Array
+    td_abs: jax.Array
+    priorities: jax.Array
+    q_taken: jax.Array
+    best_idx: jax.Array      # (B,) argmax candidate of the CURRENT state —
+                             # the proposal loss target, returned here so the
+                             # update never re-scores the candidate set
+
+
 def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
     """Elementwise Huber written exactly as the reference's branchless form
     (``utils.py:79``)."""
@@ -92,3 +102,99 @@ def make_optimizer(lr: float = 6.25e-5, decay: float = 0.95,
         optax.clip_by_global_norm(max_grad_norm),
         optax.rmsprop(lr, decay=decay, eps=eps, centered=centered),
     )
+
+
+# -- AQL (proposal-action Q-learning) --------------------------------------
+
+def aql_q_loss(
+    score_fn: Callable[..., jax.Array],
+    params: Any,
+    target_params: Any,
+    batch: dict[str, jax.Array],
+    weights: jax.Array,
+    online_noise: jax.Array,
+    target_noise: jax.Array,
+) -> tuple[jax.Array, AQLOutput]:
+    """Double-DQN TD loss over the stored candidate set (reference
+    ``compute_loss_AQL``, ``utils.py:44-61``).
+
+    ``batch['action']`` is the INDEX into ``batch['a_mu'] [B, T, A]``; both
+    current and next state are scored against the SAME stored candidate set
+    (the reference reuses the transition's ``a_mu`` for ``next_states`` too,
+    ``utils.py:47-49`` — by design: the set that produced the acted action
+    stays the comparison basis).  ``online_noise``/``target_noise`` pin one
+    NoisyNet draw per network per update, matching the
+    reset-once-per-step buffer semantics (``AQL_dis.py:104-105``).
+    """
+    obs, next_obs, a_mu = batch["obs"], batch["next_obs"], batch["a_mu"]
+    both = jnp.concatenate([obs, next_obs], axis=0)
+    a_both = jnp.concatenate([a_mu, a_mu], axis=0)
+    q_both = score_fn(params, both, a_both, online_noise)
+    q_values, next_q_values = jnp.split(q_both, 2, axis=0)
+    tgt_next_q_values = score_fn(target_params, next_obs, a_mu, target_noise)
+
+    idx = batch["action"].astype(jnp.int32)
+    q_taken = jnp.take_along_axis(q_values, idx[:, None], axis=1)[:, 0]
+    next_idx = next_q_values.argmax(axis=1)
+    next_q_taken = jnp.take_along_axis(
+        tgt_next_q_values, next_idx[:, None], axis=1)[:, 0]
+
+    target = batch["reward"] + batch["discount"] * next_q_taken
+    td = jax.lax.stop_gradient(target) - q_taken
+    td_abs = jnp.abs(td)
+    loss = (huber(td) * weights).mean()
+    return loss, AQLOutput(loss=loss, td_abs=td_abs,
+                           priorities=mixed_max_priorities(td_abs),
+                           q_taken=q_taken,
+                           best_idx=jax.lax.stop_gradient(
+                               q_values.argmax(axis=1)))
+
+
+def aql_proposal_loss(
+    log_prob_fn: Callable[..., tuple[jax.Array, jax.Array]],
+    params: Any,
+    batch: dict[str, jax.Array],
+    best_idx: jax.Array,
+    entropy_coef: float,
+) -> jax.Array:
+    """Entropy-regularized NLL of the argmax-Q candidate (reference
+    ``AQL_dis.py:79-86``): pull the proposal mean toward the action the Q
+    head currently ranks best.  ``best_idx`` comes from the Q pass and is
+    treated as data (no gradient through the argmax)."""
+    best_action = jnp.take_along_axis(
+        batch["a_mu"], best_idx[:, None, None], axis=1)[:, 0, :]
+    log_prob, entropy = log_prob_fn(params, batch["obs"],
+                                    jax.lax.stop_gradient(best_action))
+    return jnp.mean(-log_prob - entropy_coef * entropy)
+
+
+def aql_param_labels(params: Any) -> Any:
+    """'proposal' / 'q' label tree for the two-optimizer split
+    (``AQL.py:41-42``).
+
+    The state-embedding trunk belongs to the PROPOSAL group: it feeds only
+    the proposal mean (the Q score path reads raw observations through
+    ``q_feature``, reference ``model.py:294-320``).  The reference
+    accidentally freezes this trunk forever — its Q optimizer owns but never
+    gradients it, and its proposal optimizer gradients but never owns it
+    (``AQL_dis.py:87-101``).  Training it under the proposal optimizer is a
+    deliberate fix, not drift."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "proposal"
+        if any(str(getattr(k, "key", k)).startswith(("proposal", "embed"))
+               for k in path) else "q",
+        params)
+
+
+def make_aql_optimizer(q_lr: float = 1e-4, proposal_lr: float = 1e-4,
+                       max_grad_norm: float = 40.0
+                       ) -> optax.GradientTransformation:
+    """Per-group clip + Adam, split by :func:`aql_param_labels` (reference
+    clips and steps the two parameter sets independently,
+    ``AQL_dis.py:87-101``, Adam opts ``AQL.py:41-42``)."""
+    def group(lr):
+        return optax.chain(optax.clip_by_global_norm(max_grad_norm),
+                           optax.adam(lr))
+    return optax.multi_transform(
+        {"q": group(q_lr), "proposal": group(proposal_lr)},
+        aql_param_labels)
