@@ -1,0 +1,8 @@
+"""Multi-host runtime: socket transport, process roles, CLI.
+
+The reference's L4/L6 plane (ZeroMQ role scripts,
+``origin_repo/{learner,actor,replay,eval}.py``) re-designed for the TPU
+topology — replay dissolved into the learner's HBM, one shared concurrent
+loop for in-host and multi-host, role identity via env vars or flags.
+See :mod:`apex_tpu.runtime.transport` and :mod:`apex_tpu.runtime.roles`.
+"""

@@ -1,0 +1,3 @@
+from apex_tpu.runtime.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,170 @@
+"""CLI entry points: `python -m apex_tpu.runtime --role ...`.
+
+The reference is launched as four role scripts sharing one argparse
+(``origin_repo/arguments.py:5-83``) with role identity injected through env
+vars by the deploy scripts (``deploy/actor.sh:4-9``).  Same scheme here:
+every flag has an env-var twin (flag wins), `--role` defaults to
+``$APEX_ROLE``, and one binary serves every role — so the localhost
+topology script and a cluster template launch identical commands.
+
+Examples::
+
+    # learner expecting 2 actors + 1 evaluator on this host
+    python -m apex_tpu.runtime --role learner --n-actors 2 \
+        --env-id ApexCartPole-v0 --total-steps 5000
+
+    APEX_ROLE=actor ACTOR_ID=0 N_ACTORS=2 LEARNER_IP=10.0.0.2 \
+        python -m apex_tpu.runtime --env-id ApexCartPole-v0
+
+    python -m apex_tpu.runtime --role evaluator --learner-ip 10.0.0.2
+
+    # single-process (no sockets) drivers
+    python -m apex_tpu.runtime --role dqn --total-frames 20000
+    python -m apex_tpu.runtime --role enjoy --checkpoint ckpt_5000.msgpack
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+
+from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, EnvConfig,
+                             LearnerConfig, ReplayConfig, RoleIdentity)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    e = os.environ
+    p = argparse.ArgumentParser(
+        prog="apex_tpu",
+        description="TPU-native Ape-X/AQL roles (reference arguments.py)")
+    p.add_argument("--role", default=e.get("APEX_ROLE", "learner"),
+                   choices=["learner", "actor", "evaluator", "dqn", "aql",
+                            "apex", "enjoy"],
+                   help="socket roles: learner/actor/evaluator; "
+                        "single-host drivers: dqn/aql/apex; "
+                        "enjoy: eval a checkpoint")
+    p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
+                   choices=["dqn", "aql"])
+    # env
+    p.add_argument("--env-id", default=e.get("APEX_ENV_ID",
+                                             "SeaquestNoFrameskip-v4"))
+    p.add_argument("--seed", type=int, default=int(e.get("APEX_SEED", 1122)))
+    p.add_argument("--frame-stack", type=int, default=4)
+    p.add_argument("--no-clip-rewards", action="store_true")
+    p.add_argument("--no-episodic-life", action="store_true")
+    # identity (env-var twins are the reference's names, actor.py:18-25)
+    p.add_argument("--actor-id", type=int,
+                   default=int(e.get("ACTOR_ID", 0)))
+    p.add_argument("--n-actors", type=int,
+                   default=int(e.get("N_ACTORS", 1)))
+    p.add_argument("--n-evaluators", type=int,
+                   default=int(e.get("N_EVALUATORS", 1)))
+    p.add_argument("--learner-ip",
+                   default=e.get("LEARNER_IP", "127.0.0.1"))
+    # learner
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--lr", type=float, default=6.25e-5)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--n-steps", type=int, default=3)
+    p.add_argument("--target-update-interval", type=int, default=2500)
+    p.add_argument("--save-interval", type=int, default=5000)
+    p.add_argument("--total-steps", type=int, default=1_000_000)
+    p.add_argument("--total-frames", type=int, default=1_000_000)
+    p.add_argument("--max-seconds", type=float, default=86400.0)
+    p.add_argument("--train-ratio", type=float, default=None)
+    p.add_argument("--min-train-ratio", type=float, default=None)
+    # replay
+    p.add_argument("--capacity", type=int, default=2 ** 19)
+    p.add_argument("--warmup", type=int, default=50_000)
+    p.add_argument("--alpha", type=float, default=0.6)
+    p.add_argument("--beta", type=float, default=0.4)
+    # misc
+    p.add_argument("--logdir", default=e.get("APEX_LOGDIR"))
+    p.add_argument("--checkpoint-dir", default=e.get("APEX_CKPT_DIR"))
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint path (enjoy role)")
+    p.add_argument("--episodes", type=int, default=0,
+                   help="evaluator/enjoy episode budget (0 = forever)")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--barrier-timeout", type=float, default=120.0)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> ApexConfig:
+    return ApexConfig(
+        env=EnvConfig(env_id=args.env_id, seed=args.seed,
+                      frame_stack=args.frame_stack,
+                      clip_rewards=not args.no_clip_rewards,
+                      episodic_life=not args.no_episodic_life),
+        replay=ReplayConfig(capacity=args.capacity, warmup=args.warmup,
+                            alpha=args.alpha, beta=args.beta),
+        learner=LearnerConfig(batch_size=args.batch_size, lr=args.lr,
+                              gamma=args.gamma, n_steps=args.n_steps,
+                              target_update_interval=
+                              args.target_update_interval,
+                              save_interval=args.save_interval),
+        actor=ActorConfig(n_actors=args.n_actors),
+        aql=AQLConfig(),
+    )
+
+
+def identity_from_args(args: argparse.Namespace) -> RoleIdentity:
+    return RoleIdentity(role=args.role, actor_id=args.actor_id,
+                        n_actors=args.n_actors, learner_ip=args.learner_ip)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    identity = identity_from_args(args)
+
+    if args.role == "learner":
+        from apex_tpu.runtime.roles import run_learner
+        run_learner(cfg, n_peers=args.n_actors + args.n_evaluators,
+                    total_steps=args.total_steps,
+                    max_seconds=args.max_seconds, family=args.family,
+                    logdir=args.logdir, verbose=args.verbose,
+                    checkpoint_dir=args.checkpoint_dir,
+                    train_ratio=args.train_ratio,
+                    min_train_ratio=args.min_train_ratio,
+                    barrier_timeout_s=args.barrier_timeout)
+    elif args.role == "actor":
+        from apex_tpu.runtime.roles import run_actor
+        run_actor(cfg, identity, family=args.family,
+                  barrier_timeout_s=args.barrier_timeout)
+    elif args.role == "evaluator":
+        from apex_tpu.runtime.roles import run_evaluator
+        run_evaluator(cfg, identity, family=args.family,
+                      episodes=args.episodes, logdir=args.logdir,
+                      verbose=args.verbose,
+                      barrier_timeout_s=args.barrier_timeout)
+    elif args.role == "dqn":
+        from apex_tpu.training.dqn import DQNTrainer
+        DQNTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
+                   checkpoint_dir=args.checkpoint_dir).train(
+            total_frames=args.total_frames)
+    elif args.role == "aql":
+        from apex_tpu.training.aql import AQLTrainer
+        AQLTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
+                   checkpoint_dir=args.checkpoint_dir).train(
+            total_frames=args.total_frames)
+    elif args.role == "apex":
+        from apex_tpu.training.apex import ApexTrainer
+        ApexTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
+                    checkpoint_dir=args.checkpoint_dir,
+                    train_ratio=args.train_ratio,
+                    min_train_ratio=args.min_train_ratio).train(
+            total_steps=args.total_steps, max_seconds=args.max_seconds)
+    elif args.role == "enjoy":
+        from apex_tpu.training.checkpoint import evaluate_checkpoint
+        if not args.checkpoint:
+            raise SystemExit("--checkpoint required for enjoy")
+        score = evaluate_checkpoint(args.checkpoint,
+                                    episodes=args.episodes or 10)
+        print(f"enjoy: mean episode reward {score:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,260 @@
+"""Process roles for the multi-host topology (reference L6 role scripts).
+
+The reference runs four role scripts — ``origin_repo/{learner,actor,replay,
+eval}.py`` — wired by env vars (``actor.py:18-25``).  Here the replay role is
+dissolved into the learner (HBM-resident replay, see
+:mod:`apex_tpu.runtime.transport`), leaving three:
+
+* :func:`run_learner` — the standard :class:`ApexTrainer` driving a
+  socket-backed :class:`RemotePool`: identical fused learner, chunks arrive
+  over TCP instead of mp.Queue.
+* :func:`run_actor` — the SAME exploration body as the in-host pool workers
+  (``apex_tpu.actors.pool._worker_main``), with the mp queues swapped for
+  socket adapters: SUB(CONFLATE) params, DEALER chunks with the credit
+  window, stats piggybacked.  One body, two transports — the reference
+  maintains two near-copies (``batchrecorder.py`` vs ``actor.py``).
+* :func:`run_evaluator` — continuous greedy evaluation on the UNCLIPPED env,
+  streaming params without ever pausing the learner
+  (``origin_repo/eval.py:49-87``); scores are shipped to the learner's
+  metric log as stats with negative ``actor_id``s.
+
+Every role takes the shared :class:`~apex_tpu.config.ApexConfig` plus its
+role identity — exactly the reference's single-argparse + env-var scheme
+(``arguments.py:5-83``; :meth:`RoleIdentity.from_env`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_lib
+import threading
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig, CommsConfig, RoleIdentity
+from apex_tpu.runtime import transport
+
+
+# -- socket adapters with the mp.Queue interface ---------------------------
+
+class _ParamQueueAdapter:
+    """ParamSubscriber presented as the worker body's param queue.  The
+    CONFLATE socket holds at most one (newest) message, so the body's
+    drain-to-latest loop terminates after one hit."""
+
+    def __init__(self, sub: transport.ParamSubscriber):
+        self.sub = sub
+
+    def get(self, timeout: float = 0.5):
+        got = self.sub.poll(int(timeout * 1000))
+        if got is None:
+            raise queue_lib.Empty
+        return got
+
+    def get_nowait(self):
+        got = self.sub.poll(0)
+        if got is None:
+            raise queue_lib.Empty
+        return got
+
+
+class _ChunkQueueAdapter:
+    """ChunkSender presented as the worker body's chunk queue; ``put``
+    blocks on the ack-credit window like a bounded mp.Queue blocks on
+    depth."""
+
+    def __init__(self, sender: transport.ChunkSender, stop_event):
+        self.sender = sender
+        self.stop_event = stop_event
+
+    def put(self, item) -> None:
+        _kind, _actor_id, msg = item
+        self.sender.send_chunk(msg, self.stop_event)
+
+
+class _StatQueueAdapter:
+    def __init__(self, sender: transport.ChunkSender):
+        self.sender = sender
+
+    def put_nowait(self, stat) -> None:
+        self.sender.send_stat(stat)
+
+
+# -- roles -----------------------------------------------------------------
+
+def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
+                max_seconds: float = 3600.0, family: str = "dqn",
+                logdir: str | None = None, verbose: bool = False,
+                checkpoint_dir: str | None = None, train_ratio=None,
+                min_train_ratio=None, queue_depth: int = 64,
+                barrier_timeout_s: float = 120.0):
+    """Learner role: barrier -> publish -> fused ingest+train loop.
+
+    ``n_peers`` = actors + evaluators expected at the startup barrier
+    (``learner.py:48-49``).  Returns the trainer (params, metrics history).
+    """
+    pool = transport.RemotePool(cfg.comms, n_peers, queue_depth=queue_depth,
+                                barrier_timeout_s=barrier_timeout_s)
+    try:
+        if family == "dqn":
+            from apex_tpu.training.apex import ApexTrainer
+            trainer = ApexTrainer(cfg, logdir=logdir, verbose=verbose,
+                                  checkpoint_dir=checkpoint_dir,
+                                  train_ratio=train_ratio,
+                                  min_train_ratio=min_train_ratio,
+                                  pool=pool)
+        elif family == "aql":
+            from apex_tpu.training.aql import AQLApexTrainer
+            trainer = AQLApexTrainer(cfg, logdir=logdir, verbose=verbose,
+                                     checkpoint_dir=checkpoint_dir,
+                                     train_ratio=train_ratio,
+                                     min_train_ratio=min_train_ratio,
+                                     pool=pool)
+        else:
+            raise ValueError(f"unknown family {family!r}")
+    except BaseException:
+        # the pool binds its ROUTER at construction — unwind it if the
+        # trainer never gets far enough for train()'s finally to run
+        pool.cleanup()
+        raise
+    return trainer.train(total_steps=total_steps, max_seconds=max_seconds)
+
+
+def run_actor(cfg: ApexConfig, identity: RoleIdentity,
+              family: str = "dqn", stop_event=None,
+              barrier_timeout_s: float = 120.0) -> None:
+    """Actor role: barrier -> SUB params -> explore -> DEALER chunks.
+
+    Epsilon comes from the fleet-wide ladder position
+    (``actor.py:69``): ``eps_base ** (1 + id/(N-1) * eps_alpha)``.
+    """
+    from apex_tpu.actors.pool import _worker_main, actor_epsilons
+
+    stop_event = stop_event or threading.Event()
+    name = f"actor-{identity.actor_id}"
+    comms = _with_ips(cfg.comms, identity)
+    if not transport.barrier_wait(comms, name, stop_event=stop_event,
+                                  timeout_s=barrier_timeout_s):
+        raise TimeoutError(f"{name}: startup barrier timed out")
+    eps = actor_epsilons(identity.n_actors, cfg.actor.eps_base,
+                         cfg.actor.eps_alpha)[identity.actor_id]
+
+    sub = transport.ParamSubscriber(comms)
+    sender = transport.ChunkSender(comms, name)
+    if family == "dqn":
+        from apex_tpu.training.apex import dqn_model_spec
+        worker_fn, model_spec = _worker_main, dqn_model_spec(cfg)
+    elif family == "aql":
+        from apex_tpu.actors.aql import aql_worker_main
+        from apex_tpu.envs.registry import make_env
+        from apex_tpu.training.aql import aql_model_spec
+        probe = make_env(cfg.env.env_id, cfg.env, seed=0)
+        worker_fn, model_spec = aql_worker_main, aql_model_spec(cfg, probe)
+        probe.close()
+    else:
+        raise ValueError(f"unknown family {family!r}")
+    try:
+        worker_fn(identity.actor_id, cfg, model_spec,
+                  _ChunkQueueAdapter(sender, stop_event),
+                  _ParamQueueAdapter(sub), _StatQueueAdapter(sender),
+                  stop_event, float(eps), cfg.actor.send_interval)
+    finally:
+        sender.close()
+        sub.close()
+
+
+def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
+                  family: str = "dqn", stop_event=None, episodes: int = 0,
+                  max_steps: int = 10_000, logdir: str | None = None,
+                  verbose: bool = False,
+                  barrier_timeout_s: float = 120.0) -> list[float]:
+    """Evaluator role (``eval.py:49-87``): greedy episodes on the unclipped
+    env, refreshing params per episode, forever (or ``episodes`` if > 0).
+    Scores are logged locally AND shipped to the learner (actor_id = -(id+1))."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.actors.pool import EpisodeStat
+    from apex_tpu.envs.registry import make_eval_env
+    from apex_tpu.utils.metrics import MetricLogger
+
+    stop_event = stop_event or threading.Event()
+    identity = identity or RoleIdentity(role="evaluator")
+    # unique per-evaluator socket/barrier identity: duplicate identities
+    # dedup at the barrier (deadlock) and misroute on the ROUTER
+    name = f"evaluator-{identity.actor_id}"
+    comms = _with_ips(cfg.comms, identity)
+    if not transport.barrier_wait(comms, name, stop_event=stop_event,
+                                  timeout_s=barrier_timeout_s):
+        raise TimeoutError(f"{name}: startup barrier timed out")
+
+    sub = transport.ParamSubscriber(comms)
+    sender = transport.ChunkSender(comms, name)
+    log = MetricLogger("evaluator", logdir, verbose=verbose)
+    env = make_eval_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed + 7777)
+
+    if family == "dqn":
+        import jax.numpy as jnp  # noqa: F811
+
+        from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+        from apex_tpu.training.apex import dqn_model_spec
+        model = DuelingDQN(**dqn_model_spec(cfg))
+        policy = jax.jit(make_policy_fn(model))
+
+        def act(params, obs, key):
+            a, _ = policy(params, obs[None], jnp.float32(0.0), key)
+            return int(a[0])
+    elif family == "aql":
+        from apex_tpu.envs.registry import make_env
+        from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
+        from apex_tpu.training.aql import aql_model_spec
+        probe = make_env(cfg.env.env_id, cfg.env, seed=0)
+        model = AQLNetwork(**aql_model_spec(cfg, probe),
+                           noisy_deterministic=True)
+        probe.close()
+        policy = jax.jit(make_aql_policy_fn(model))
+
+        def act(params, obs, key):
+            a, _, _, _ = policy(params, obs[None], jnp.float32(0.0), key)
+            return np.asarray(a[0])
+    else:
+        raise ValueError(f"unknown family {family!r}")
+
+    got = sub.wait_first(stop_event)
+    if got is None:
+        return []
+    version, params = got
+    key = jax.random.key(cfg.env.seed + 31337)
+    scores: list[float] = []
+    ep = 0
+    while not stop_event.is_set() and (episodes <= 0 or ep < episodes):
+        obs, _ = env.reset()
+        total, done, steps = 0.0, False, 0
+        while not done and steps < max_steps and not stop_event.is_set():
+            key, k = jax.random.split(key)
+            obs, r, term, trunc, _ = env.step(act(params, np.asarray(obs), k))
+            total += float(r)
+            done = term or trunc
+            steps += 1
+        scores.append(total)
+        log.scalars({"episode_reward": total, "episode_length": steps,
+                     "param_version": version}, ep)
+        sender.send_stat(EpisodeStat(-(identity.actor_id + 1), total, steps,
+                                     version))
+        got = sub.poll(0)               # param refresh per episode
+        if got is not None:
+            version, params = got
+        ep += 1
+    sender.close()
+    sub.close()
+    env.close()
+    return scores
+
+
+def _with_ips(comms: CommsConfig, identity: RoleIdentity) -> CommsConfig:
+    """An EXPLICIT learner IP on the role identity wins over the config
+    (``actor.py:18-25`` env-var pattern); a default-constructed identity
+    must not stomp a configured ``comms.learner_ip`` with localhost."""
+    if identity.learner_ip != RoleIdentity().learner_ip:
+        return dataclasses.replace(comms, learner_ip=identity.learner_ip)
+    return comms

@@ -1,0 +1,295 @@
+"""Socket transport for the multi-host plane (L4/C13-C15 re-design).
+
+The reference's inter-node fabric is ZeroMQ TCP with four patterns:
+PUB/SUB + CONFLATE for params (``origin_repo/learner.py:57-68``,
+``actor.py:40-49``), DEALER/ROUTER with bounded outstanding-send windows for
+transition and priority streams (``actor.py:105-115``,
+``learner.py:117-131``), REQ/ROUTER for the startup barrier
+(``learner.py:30-54``, ``actor.py:28-37``), and three ``zmq.proxy`` devices
+bridging into a standalone replay server (``replay.py:48-74``).
+
+The TPU topology DISSOLVES the replay server: replay lives in the learner's
+HBM (SURVEY.md §7), so the remote-ingest role collapses to one
+ROUTER on the learner that feeds the fused ingest+train step directly —
+C15's capability (other hosts feeding the learner) with one fewer hop and
+no shared-lock bottleneck (``origin_repo/README.md:11``).  What remains:
+
+* :class:`ParamPublisher` / :class:`ParamSubscriber` — version-stamped
+  latest-wins broadcast (SUB sets ``CONFLATE=1``: exactly the reference's
+  staleness bound).
+* :class:`ChunkSender` / :class:`ChunkReceiver` — actor->learner transition
+  chunks with an explicit ack-based credit window (the reference bounds
+  un-acked sends at 3, ``actor.py:110-114``).  Stats ride the same pipe as
+  a second message kind.
+* :class:`barrier_wait` / :class:`barrier_release` — startup handshake; the
+  learner publishes nothing until every expected peer has checked in.
+
+Wire format is pickle over zmq frames, like the reference's cPickle
+(``actor.py:1``, ``learner.py:6``); a trusted-cluster assumption both
+systems share.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue as queue_lib
+import threading
+import time
+from dataclasses import dataclass
+
+import zmq
+
+from apex_tpu.config import CommsConfig
+
+
+def _ctx() -> zmq.Context:
+    return zmq.Context.instance()
+
+
+# -- param plane -----------------------------------------------------------
+
+class ParamPublisher:
+    """Learner-side PUB socket (``learner.py:57-68``): send-and-forget with
+    a small HWM; slow subscribers see only the latest version."""
+
+    def __init__(self, comms: CommsConfig, bind_ip: str = "*"):
+        self.sock = _ctx().socket(zmq.PUB)
+        self.sock.setsockopt(zmq.SNDHWM, comms.param_hwm)
+        self.sock.bind(f"tcp://{bind_ip}:{comms.param_port}")
+
+    def publish(self, version: int, params) -> None:
+        self.sock.send(pickle.dumps((version, params), protocol=5))
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class ParamSubscriber:
+    """Actor/evaluator-side SUB with CONFLATE=1 — the kernel keeps exactly
+    the newest message (``actor.py:40-49`` semantics, no user-space drain
+    loop needed)."""
+
+    def __init__(self, comms: CommsConfig, learner_ip: str | None = None):
+        self.sock = _ctx().socket(zmq.SUB)
+        self.sock.setsockopt(zmq.CONFLATE, 1)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        ip = learner_ip or comms.learner_ip
+        self.sock.connect(f"tcp://{ip}:{comms.param_port}")
+
+    def poll(self, timeout_ms: int = 0):
+        """Newest ``(version, params)`` or None."""
+        if self.sock.poll(timeout_ms, zmq.POLLIN):
+            return pickle.loads(self.sock.recv())
+        return None
+
+    def wait_first(self, stop_event=None, timeout_ms: int = 500):
+        """Block (interruptibly) for the first publish
+        (``actor.py:72-74``)."""
+        while stop_event is None or not stop_event.is_set():
+            got = self.poll(timeout_ms)
+            if got is not None:
+                return got
+        return None
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+# -- chunk/stat plane ------------------------------------------------------
+
+class ChunkSender:
+    """Actor-side DEALER with an ack-credit window: at most
+    ``max_outstanding`` chunks in flight (``actor.py:110-114``).  Stats are
+    fire-and-forget on the same socket (no credit consumed)."""
+
+    def __init__(self, comms: CommsConfig, identity: str,
+                 learner_ip: str | None = None):
+        self.sock = _ctx().socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, identity.encode())
+        ip = learner_ip or comms.learner_ip
+        self.sock.connect(f"tcp://{ip}:{comms.batch_port}")
+        self.max_outstanding = comms.max_outstanding_sends
+        self._in_flight = 0
+
+    def _drain_acks(self, timeout_ms: int) -> None:
+        while self.sock.poll(timeout_ms, zmq.POLLIN):
+            self.sock.recv()
+            self._in_flight = max(0, self._in_flight - 1)
+            timeout_ms = 0
+
+    def send_chunk(self, msg: dict, stop_event=None) -> bool:
+        """Blocks while the credit window is exhausted; False if stopped."""
+        self._drain_acks(0)
+        while self._in_flight >= self.max_outstanding:
+            if stop_event is not None and stop_event.is_set():
+                return False
+            self._drain_acks(100)
+        self.sock.send(pickle.dumps(("chunk", msg), protocol=5))
+        self._in_flight += 1
+        return True
+
+    def send_stat(self, stat) -> None:
+        self.sock.send(pickle.dumps(("stat", stat), protocol=5))
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class ChunkReceiver:
+    """Learner-side ROUTER thread: receive, ack, enqueue.  Acks grant the
+    sender's next credit, so the bounded local queues backpressure the whole
+    fleet end-to-end (the reference got this from the replay server's recv
+    windows, ``replay.py:104-146``)."""
+
+    def __init__(self, comms: CommsConfig, bind_ip: str = "*",
+                 queue_depth: int = 64):
+        self.sock = _ctx().socket(zmq.ROUTER)
+        self.sock.bind(f"tcp://{bind_ip}:{comms.batch_port}")
+        self.chunks: queue_lib.Queue = queue_lib.Queue(maxsize=queue_depth)
+        self.stats: queue_lib.Queue = queue_lib.Queue(maxsize=1024)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.sock.poll(100, zmq.POLLIN):
+                continue
+            ident, payload = self.sock.recv_multipart()
+            kind, body = pickle.loads(payload)
+            if kind == "chunk":
+                # enqueue BEFORE acking: the ack is the credit grant
+                while not self._stop.is_set():
+                    try:
+                        self.chunks.put(body, timeout=0.1)
+                        self.sock.send_multipart([ident, b"ack"])
+                        break
+                    except queue_lib.Full:
+                        continue
+            elif kind == "stat":
+                try:
+                    self.stats.put_nowait(body)
+                except queue_lib.Full:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:   # tolerate never-started
+            self._thread.join(timeout=5)
+        self.sock.close(linger=0)
+
+
+# -- startup barrier -------------------------------------------------------
+
+def barrier_release(comms: CommsConfig, n_peers: int, bind_ip: str = "*",
+                    stop_event=None, timeout_s: float = 120.0) -> int:
+    """Learner side (``learner.py:30-54``): collect ``n_peers`` hellos on a
+    ROUTER, then release them all.  Returns peers released."""
+    sock = _ctx().socket(zmq.ROUTER)
+    sock.bind(f"tcp://{bind_ip}:{comms.barrier_port}")
+    try:
+        idents = []
+        deadline = time.monotonic() + timeout_s
+        while len(idents) < n_peers and time.monotonic() < deadline:
+            if stop_event is not None and stop_event.is_set():
+                break
+            if sock.poll(100, zmq.POLLIN):
+                ident, _empty, _hello = sock.recv_multipart()
+                if ident not in idents:
+                    idents.append(ident)
+        for ident in idents:
+            sock.send_multipart([ident, b"", b"go"])
+        return len(idents)
+    finally:
+        sock.close(linger=0)
+
+
+def barrier_wait(comms: CommsConfig, identity: str,
+                 learner_ip: str | None = None, stop_event=None,
+                 timeout_s: float = 120.0) -> bool:
+    """Actor/evaluator side (``actor.py:28-37``): REQ hello, block for go."""
+    sock = _ctx().socket(zmq.REQ)
+    sock.setsockopt(zmq.IDENTITY, identity.encode())
+    ip = learner_ip or comms.learner_ip
+    sock.connect(f"tcp://{ip}:{comms.barrier_port}")
+    try:
+        sock.send(b"hello")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if stop_event is not None and stop_event.is_set():
+                return False
+            if sock.poll(100, zmq.POLLIN):
+                sock.recv()
+                return True
+        return False
+    finally:
+        sock.close(linger=0)
+
+
+@dataclass
+class RemotePool:
+    """Socket-backed drop-in for :class:`apex_tpu.actors.pool.ActorPool` —
+    the :class:`~apex_tpu.training.apex.ConcurrentTrainer` loop drives
+    either through the same five methods, so one learner implementation
+    serves the in-host and multi-host topologies.
+
+    ``n_peers`` is the barrier head-count (actors + evaluators,
+    ``learner.py:48-49`` counts the evaluator as actor "+1").
+    """
+
+    comms: CommsConfig
+    n_peers: int
+    queue_depth: int = 64
+    barrier_timeout_s: float = 120.0
+
+    # pre-first-step republish keeps late-joining SUB sockets alive
+    # (ConcurrentTrainer checks this attribute; mp pools don't need it)
+    needs_warmup_republish = True
+
+    def __post_init__(self):
+        self.receiver = ChunkReceiver(self.comms,
+                                      queue_depth=self.queue_depth)
+        self.publisher: ParamPublisher | None = None
+        self.procs: list = []           # interface parity (nothing local)
+
+    def start(self) -> None:
+        self.receiver.start()
+        self.publisher = ParamPublisher(self.comms)
+        released = barrier_release(self.comms, self.n_peers,
+                                   timeout_s=self.barrier_timeout_s)
+        if released < self.n_peers:
+            # unwind: leave no bound ports / live threads behind a failed
+            # start, or a same-process retry dies with EADDRINUSE
+            self.cleanup()
+            raise TimeoutError(
+                f"startup barrier: {released}/{self.n_peers} peers")
+
+    def cleanup(self) -> None:
+        self.receiver.stop()
+        if self.publisher is not None:
+            self.publisher.close()
+
+    def publish_params(self, version: int, params) -> None:
+        self.publisher.publish(version, params)
+
+    def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:
+        out = []
+        for _ in range(max_chunks):
+            try:
+                msg = (self.receiver.chunks.get(timeout=timeout) if timeout
+                       else self.receiver.chunks.get_nowait())
+            except queue_lib.Empty:
+                break
+            out.append(msg)
+        return out
+
+    def poll_stats(self) -> list:
+        out = []
+        try:
+            while True:
+                out.append(self.receiver.stats.get_nowait())
+        except queue_lib.Empty:
+            pass
+        return out

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Localhost all-roles topology (reference origin_repo/run.sh:1-5: tmux panes
+# for replay/learner/actor/eval on 127.0.0.1).  Replay is dissolved into the
+# learner here, so the topology is learner + N actors + evaluator.
+#
+# Usage: scripts/run_local.sh [ENV_ID] [N_ACTORS] [TOTAL_STEPS]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENV_ID="${1:-ApexCartPole-v0}"
+N_ACTORS="${2:-2}"
+TOTAL_STEPS="${3:-2000}"
+
+# CPU platform for every role: actors/evaluator must never dial the
+# single-client TPU tunnel; drop the env vars on the learner line to put its
+# fused step on the chip.
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
+        --batch-size 64 --capacity 8192 --warmup 500
+        --barrier-timeout 600)
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+for i in $(seq 0 $((N_ACTORS - 1))); do
+  python -m apex_tpu.runtime --role actor --actor-id "$i" \
+    "${COMMON[@]}" &
+  pids+=($!)
+done
+python -m apex_tpu.runtime --role evaluator --episodes 0 --verbose \
+  "${COMMON[@]}" &
+pids+=($!)
+
+# learner runs in the foreground; barrier holds until every peer dials in
+python -m apex_tpu.runtime --role learner --total-steps "$TOTAL_STEPS" \
+  --verbose "${COMMON[@]}"
