@@ -1,0 +1,70 @@
+"""Chunk aggregation for the dp-sharded learner.
+
+Frame chunks are SELF-CONTAINED (internal frame refs), so a single chunk
+cannot be split across replay shards; instead whole chunks round-robin onto
+chips: the aggregator buffers worker messages until it holds one per chip,
+then stacks them on a leading ``dp`` axis for the sharded fused step.  This
+preserves the interleaved-stream assumption behind local per-shard sampling
+(:mod:`apex_tpu.parallel.learner` docstring) — consecutive chunks, which
+come from different actors, land on different chips.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ChunkAggregator:
+    """Pool wrapper: groups ``n_dp`` chunk messages into one stacked
+    sharded message; every other pool method delegates, so the shared
+    concurrent loop drives it unchanged."""
+
+    def __init__(self, pool, n_dp: int):
+        self.pool = pool
+        self.n_dp = n_dp
+        self._buf: list[dict] = []
+
+    # -- delegation ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.pool.start()
+
+    def cleanup(self) -> None:
+        self.pool.cleanup()
+
+    def publish_params(self, version: int, params) -> None:
+        self.pool.publish_params(version, params)
+
+    def poll_stats(self):
+        return self.pool.poll_stats()
+
+    @property
+    def procs(self):
+        return self.pool.procs
+
+    @property
+    def needs_warmup_republish(self):
+        return getattr(self.pool, "needs_warmup_republish", False)
+
+    # -- aggregation --------------------------------------------------------
+
+    def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:
+        """Return one stacked message per ``n_dp`` buffered chunks."""
+        out = []
+        for _ in range(max_chunks):
+            need = self.n_dp - len(self._buf)
+            if need > 0:
+                self._buf.extend(self.pool.poll_chunks(need, timeout))
+            if len(self._buf) < self.n_dp:
+                break
+            msgs, self._buf = self._buf[:self.n_dp], self._buf[self.n_dp:]
+            payload = {k: np.stack([np.asarray(m["payload"][k])
+                                    for m in msgs])
+                       for k in msgs[0]["payload"]}
+            out.append({
+                "payload": payload,
+                "priorities": np.stack([np.asarray(m["priorities"])
+                                        for m in msgs]),
+                "n_trans": sum(int(m["n_trans"]) for m in msgs),
+            })
+        return out

@@ -106,6 +106,49 @@ class ShardedLearner:
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    def make_train_step(self):
+        """Sample/update only (no ingest) — the learner's catch-up step when
+        no chunk is pending."""
+        core = self.core
+        per_chip_batch = core.batch_size // self.n_dp
+        assert per_chip_batch * self.n_dp == core.batch_size
+
+        def per_chip(ts: TrainState, rs: ReplayState, key: jax.Array,
+                     beta: jax.Array):
+            rs = jax.tree.map(lambda x: x[0], rs)
+            key = jax.random.wrap_key_data(key[0])
+            batch, weights, idx = core.replay.sample(
+                rs, key, per_chip_batch, beta)
+            new_ts, priorities, metrics = core.update_from_batch(
+                ts, batch, weights, axis_name="dp")
+            rs = core.replay.update_priorities(rs, idx, priorities)
+            rs = jax.tree.map(lambda x: x[None], rs)
+            return new_ts, rs, metrics
+
+        mapped = jax.shard_map(
+            per_chip, mesh=self.mesh,
+            in_specs=(P(), P("dp"), P("dp"), P()),
+            out_specs=(P(), P("dp"), P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def make_ingest(self):
+        """Ingest only (pre-warmup): one chunk per chip, no training."""
+        core = self.core
+
+        def per_chip(rs: ReplayState, ingest: Any, prios: jax.Array):
+            rs = jax.tree.map(lambda x: x[0], rs)
+            ingest = jax.tree.map(lambda x: x[0], ingest)
+            rs = core.replay.add(rs, ingest, prios[0])
+            return jax.tree.map(lambda x: x[None], rs)
+
+        mapped = jax.shard_map(
+            per_chip, mesh=self.mesh,
+            in_specs=(P("dp"), P("dp"), P("dp")),
+            out_specs=P("dp"),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,))
+
     # -- host-side helpers -------------------------------------------------
 
     def split_ingest(self, batch: dict[str, jax.Array], prios: jax.Array):

@@ -277,16 +277,22 @@ class ApexTrainer(ConcurrentTrainer):
             apply_fn=self.model.apply, replay=self.replay, optimizer=optimizer,
             batch_size=lc.batch_size,
             target_update_interval=lc.target_update_interval)
-        self.replay_state = self.replay.init()
-        self._fused = self.core.jit_fused_step()
-        self._train = self.core.jit_train_step()
-        self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_policy_fn(self.model))
 
         # pool injection: the multi-host learner passes a socket-backed
         # RemotePool; default is the in-host process pool
         self.pool = pool if pool is not None else ActorPool(
             cfg, self.model_spec, chunk_transitions=cfg.actor.send_interval)
+
+        self.n_dp = int(np.prod(lc.mesh_shape))
+        if self.n_dp > 1:
+            self._init_sharded()
+        else:
+            self.replay_state = self.replay.init()
+            self._fused = self.core.jit_fused_step()
+            self._train = self.core.jit_train_step()
+            self._ingest = self.core.jit_ingest()
+
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()
@@ -294,6 +300,39 @@ class ApexTrainer(ConcurrentTrainer):
         self.param_version = 0
         self.checkpointer = (Checkpointer(checkpoint_dir)
                              if checkpoint_dir else None)
+
+    def _init_sharded(self) -> None:
+        """dp > 1: shard the frame-pool replay per chip, pmean grads over
+        ICI, round-robin whole chunks across shards (BASELINE.json north
+        star: HBM replay + 8-chip learner).  Total replay capacity =
+        per-chip capacity x dp."""
+        from apex_tpu.parallel.aggregate import ChunkAggregator
+        from apex_tpu.parallel.learner import ShardedLearner
+        from apex_tpu.parallel.mesh import make_mesh
+
+        n = self.n_dp
+        devices = jax.devices()
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
+                f"devices, have {len(devices)}")
+        mesh = make_mesh(dp=n, devices=devices[:n])
+        sl = self.sharded = ShardedLearner(self.core, mesh)
+        self.replay_state = sl.init_replay(None)
+        self.train_state = sl.replicate_train_state(self.train_state)
+        self.pool = ChunkAggregator(self.pool, n)
+
+        fused = sl.make_fused_step()
+        train = sl.make_train_step()
+        ingest = sl.make_ingest()
+
+        def _fused(ts, rs, payload, prios, key, beta):
+            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
+
+        def _train(ts, rs, key, beta):
+            return train(ts, rs, sl.device_keys(key), beta)
+
+        self._fused, self._train, self._ingest = _fused, _train, ingest
 
     # -- evaluation --------------------------------------------------------
 
