@@ -81,7 +81,11 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
         env = (toy.ContinuousNavEnv(max_episode_steps=max_episode_steps)
                if max_episode_steps is not None else toy.ContinuousNavEnv())
     elif env_id.startswith("ApexCatch"):
-        env = toy.CatchEnv()
+        # Small variant: 7x7 grid rendered to 42x42 (smallest input the
+        # Nature conv geometry accepts), 3 balls — a CI-scale task the conv
+        # path can crack in a few thousand updates (6-step credit horizon)
+        env = (toy.CatchEnv(grid=7, pixels=42, balls=3)
+               if "Small" in env_id else toy.CatchEnv())
         if max_episode_steps is not None:
             env = wrappers.TimeLimit(env, max_episode_steps)
         if stack_frames and cfg.frame_stack > 1:

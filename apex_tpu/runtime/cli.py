@@ -26,7 +26,6 @@ Examples::
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import os
 
 from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, EnvConfig,
@@ -35,10 +34,11 @@ from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, EnvConfig,
 
 def build_parser() -> argparse.ArgumentParser:
     e = os.environ
+    ident = RoleIdentity.from_env(e)
     p = argparse.ArgumentParser(
         prog="apex_tpu",
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
-    p.add_argument("--role", default=e.get("APEX_ROLE", "learner"),
+    p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "dqn", "aql",
                             "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator; "
@@ -53,15 +53,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--frame-stack", type=int, default=4)
     p.add_argument("--no-clip-rewards", action="store_true")
     p.add_argument("--no-episodic-life", action="store_true")
-    # identity (env-var twins are the reference's names, actor.py:18-25)
-    p.add_argument("--actor-id", type=int,
-                   default=int(e.get("ACTOR_ID", 0)))
-    p.add_argument("--n-actors", type=int,
-                   default=int(e.get("N_ACTORS", 1)))
+    # identity (env-var twins are the reference's names, actor.py:18-25;
+    # RoleIdentity.from_env above is the canonical reader, flags win)
+    p.add_argument("--actor-id", type=int, default=ident.actor_id)
+    p.add_argument("--n-actors", type=int, default=ident.n_actors)
     p.add_argument("--n-evaluators", type=int,
                    default=int(e.get("N_EVALUATORS", 1)))
-    p.add_argument("--learner-ip",
-                   default=e.get("LEARNER_IP", "127.0.0.1"))
+    p.add_argument("--learner-ip", default=ident.learner_ip)
     # learner
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--lr", type=float, default=6.25e-5)
@@ -69,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-steps", type=int, default=3)
     p.add_argument("--target-update-interval", type=int, default=2500)
     p.add_argument("--save-interval", type=int, default=5000)
+    p.add_argument("--mesh-dp", type=int,
+                   default=int(e.get("APEX_MESH_DP", 0)),
+                   help="learner dp mesh degree: shard the replay across "
+                        "this many chips with pmean gradient sync; 0 = all "
+                        "local devices (learner/apex roles), 1 = single "
+                        "chip")
     p.add_argument("--total-steps", type=int, default=1_000_000)
     p.add_argument("--total-frames", type=int, default=1_000_000)
     p.add_argument("--max-seconds", type=float, default=86400.0)
@@ -91,6 +95,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _mesh_shape(args: argparse.Namespace) -> tuple[int, ...]:
+    """dp degree for the learner mesh; 0 = every local device (only the
+    learner-side roles initialize jax to count them)."""
+    dp = args.mesh_dp
+    if dp == 0:
+        if args.role in ("learner", "apex"):
+            import jax
+            dp = len(jax.devices())
+        else:
+            dp = 1
+    return (dp,)
+
+
 def config_from_args(args: argparse.Namespace) -> ApexConfig:
     return ApexConfig(
         env=EnvConfig(env_id=args.env_id, seed=args.seed,
@@ -103,7 +120,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                               gamma=args.gamma, n_steps=args.n_steps,
                               target_update_interval=
                               args.target_update_interval,
-                              save_interval=args.save_interval),
+                              save_interval=args.save_interval,
+                              mesh_shape=_mesh_shape(args)),
         actor=ActorConfig(n_actors=args.n_actors),
         aql=AQLConfig(),
     )

@@ -171,18 +171,18 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     """Evaluator role (``eval.py:49-87``): greedy episodes on the unclipped
     env, refreshing params per episode, forever (or ``episodes`` if > 0).
     Scores are logged locally AND shipped to the learner (actor_id = -(id+1))."""
-    import jax
-    import jax.numpy as jnp
+    import uuid
 
-    from apex_tpu.actors.pool import EpisodeStat
     from apex_tpu.envs.registry import make_eval_env
     from apex_tpu.utils.metrics import MetricLogger
 
     stop_event = stop_event or threading.Event()
     identity = identity or RoleIdentity(role="evaluator")
     # unique per-evaluator socket/barrier identity: duplicate identities
-    # dedup at the barrier (deadlock) and misroute on the ROUTER
-    name = f"evaluator-{identity.actor_id}"
+    # dedup at the barrier (deadlock) and misroute on the ROUTER.  The
+    # random suffix makes N default-launched evaluators safe — unlike
+    # actors, evaluator ids carry no semantics (no epsilon ladder slot)
+    name = f"evaluator-{identity.actor_id}-{uuid.uuid4().hex[:6]}"
     comms = _with_ips(cfg.comms, identity)
     if not transport.barrier_wait(comms, name, stop_event=stop_event,
                                   timeout_s=barrier_timeout_s):
@@ -192,10 +192,23 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     sender = transport.ChunkSender(comms, name)
     log = MetricLogger("evaluator", logdir, verbose=verbose)
     env = make_eval_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed + 7777)
+    try:
+        return _evaluator_body(cfg, identity, family, stop_event, episodes,
+                               max_steps, sub, sender, log, env)
+    finally:
+        sender.close()
+        sub.close()
+        env.close()
+
+
+def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
+                    sub, sender, log, env) -> list[float]:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.actors.pool import EpisodeStat
 
     if family == "dqn":
-        import jax.numpy as jnp  # noqa: F811
-
         from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
         from apex_tpu.training.apex import dqn_model_spec
         model = DuelingDQN(**dqn_model_spec(cfg))
@@ -245,9 +258,6 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
         if got is not None:
             version, params = got
         ep += 1
-    sender.close()
-    sub.close()
-    env.close()
     return scores
 
 

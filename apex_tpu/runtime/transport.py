@@ -129,7 +129,13 @@ class ChunkSender:
         return True
 
     def send_stat(self, stat) -> None:
-        self.sock.send(pickle.dumps(("stat", stat), protocol=5))
+        """Best-effort, NEVER blocks: stats are droppable telemetry, and a
+        blocking send would wedge the actor loop if the learner dies."""
+        try:
+            self.sock.send(pickle.dumps(("stat", stat), protocol=5),
+                           zmq.DONTWAIT)
+        except zmq.Again:
+            pass
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -199,8 +205,12 @@ def barrier_release(comms: CommsConfig, n_peers: int, bind_ip: str = "*",
                 ident, _empty, _hello = sock.recv_multipart()
                 if ident not in idents:
                     idents.append(ident)
-        for ident in idents:
-            sock.send_multipart([ident, b"", b"go"])
+        if len(idents) == n_peers:
+            # all-or-nothing: releasing a partial fleet while the learner
+            # aborts would strand the released peers in their work loops;
+            # unreleased peers time out in barrier_wait and exit cleanly
+            for ident in idents:
+                sock.send_multipart([ident, b"", b"go"])
         return len(idents)
     finally:
         sock.close(linger=0)

@@ -1,0 +1,60 @@
+"""Profiler hooks + MFU accounting (SURVEY.md §5.1).
+
+The reference's observability is wall-clock BPS prints
+(``origin_repo/learner.py:171-175``).  TPU-side we add the two numbers that
+actually locate a bottleneck:
+
+* :func:`trace` — ``jax.profiler`` trace context; open the dump in
+  TensorBoard/XProf to see per-op HBM + MXU utilization.
+* :func:`flops_per_call` / :func:`mfu` — XLA's own cost analysis for a
+  jitted callable, turned into model-FLOPs-utilization given the chip's
+  peak.  This is the honest "how much of the MXU are we using" metric for
+  the fused learner step (bench.py reports it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+# bf16 peak FLOPs/s per chip for common TPU generations (public specs);
+# bench/callers can override explicitly.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+}
+DEFAULT_PEAK = PEAK_FLOPS["v5e"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """``with trace("/tmp/prof"): run_steps()`` -> XProf dump in logdir."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def flops_per_call(jitted, *args, **kwargs) -> float | None:
+    """XLA-estimated FLOPs of one call of a jitted function, or None when
+    the backend exposes no cost analysis (e.g. some CPU builds)."""
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):      # one entry per device program
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:
+        return None
+
+
+def mfu(flops: float | None, calls_per_sec: float,
+        peak_flops: float = DEFAULT_PEAK) -> float | None:
+    """Model-FLOPs-utilization in [0, 1]."""
+    if flops is None or peak_flops <= 0:
+        return None
+    return flops * calls_per_sec / peak_flops

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Actor-node bootstrap (reference origin_repo/deploy/actor.sh:4-9): one tmux
+# session per actor process, global ACTOR_ID = node_id * per_node + idx.
+set -euo pipefail
+cd /opt
+git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
+cd apex-tpu
+pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+
+idx=0
+while [ $idx -lt ${actors_per_node} ]; do
+  ACTOR_ID=$(( ${node_id} * ${actors_per_node} + idx ))
+  tmux new -s "actor-$ACTOR_ID" -d \
+    "JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
+     LEARNER_IP=${learner_ip} python -m apex_tpu.runtime \
+     --env-id ${env_id} --barrier-timeout 1800; read"
+  idx=$(( idx + 1 ))
+done

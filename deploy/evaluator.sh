@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Evaluator bootstrap (reference origin_repo/deploy/evaluator.sh): greedy
+# unclipped scoring streamed from the learner's param PUB.
+set -euo pipefail
+cd /opt
+git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
+cd apex-tpu
+pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+
+tmux new -s evaluator -d \
+  "JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
+   --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
+   --barrier-timeout 1800 --verbose; read"
+tmux new -s tensorboard -d "tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Learner bootstrap (reference origin_repo/deploy/learner.sh): clone, install,
+# launch the learner role in tmux.  Runs on the TPU VM; jax[tpu] drives the
+# local slice as an n-chip dp mesh.
+set -euo pipefail
+cd /opt
+git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
+cd apex-tpu
+pip install -e . 'jax[tpu]' pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+
+N_CHIPS=$(python -c 'import jax; print(len(jax.devices()))')
+tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
+  --role learner --env-id ${env_id} --n-actors ${n_actors} \
+  --batch-size 512 --train-ratio 16 --min-train-ratio 2 \
+  --checkpoint-dir /opt/apex-tpu/ckpts --barrier-timeout 1800 --verbose; read"
+tmux new -s tensorboard -d "tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

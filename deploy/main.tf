@@ -1,0 +1,113 @@
+# GCP topology for apex-tpu (re-design of origin_repo/deploy/deploy.tf):
+# TPU-VM learner (replay dissolved into its HBM) + CPU actor fleet +
+# evaluator.  Per-role startup scripts mirror the reference's tmux
+# bootstraps (deploy/actor.sh etc.).
+
+terraform {
+  required_providers {
+    google = { source = "hashicorp/google" }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+  zone    = var.zone
+}
+
+output "learner_ip" {
+  value = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
+}
+
+# -- network ---------------------------------------------------------------
+# The reference opens 51001-51003 (replay) and 52001-52002 (learner)
+# (deploy.tf:64-126); without the replay server only the learner ports
+# remain: 51001 chunk ingest, 52001 param PUB, 52002 barrier.
+
+resource "google_compute_firewall" "apex_ports" {
+  name    = "apex-tpu-ports"
+  network = "default"
+
+  allow {
+    protocol = "tcp"
+    ports    = ["51001", "52001", "52002", "6006"] # 6006: tensorboard
+  }
+
+  source_tags = ["apex-actor", "apex-evaluator"]
+  target_tags = ["apex-learner"]
+}
+
+# -- learner (TPU VM) ------------------------------------------------------
+
+resource "google_tpu_v2_vm" "learner" {
+  name                = "apex-learner"
+  zone                = var.zone
+  runtime_version     = var.tpu_runtime_version
+  accelerator_type    = var.tpu_accelerator_type
+
+  metadata = {
+    startup-script = templatefile("${path.module}/learner.sh", {
+      repo_url = var.repo_url
+      env_id   = var.env_id
+      n_actors = var.actor_node_count * var.actors_per_node
+    })
+  }
+
+  tags = ["apex-learner"]
+}
+
+# -- actor fleet -----------------------------------------------------------
+
+resource "google_compute_instance" "actor" {
+  count        = var.actor_node_count
+  name         = "apex-actor-${count.index}"
+  machine_type = var.actor_machine_type
+  tags         = ["apex-actor"]
+
+  boot_disk {
+    initialize_params {
+      image = "ubuntu-os-cloud/ubuntu-2204-lts"
+      size  = 50
+    }
+  }
+
+  network_interface {
+    network = "default"
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile("${path.module}/actor.sh", {
+    repo_url        = var.repo_url
+    env_id          = var.env_id
+    node_id         = count.index
+    actors_per_node = var.actors_per_node
+    n_actors        = var.actor_node_count * var.actors_per_node
+    learner_ip      = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
+  })
+}
+
+# -- evaluator -------------------------------------------------------------
+
+resource "google_compute_instance" "evaluator" {
+  name         = "apex-evaluator"
+  machine_type = var.evaluator_machine_type
+  tags         = ["apex-evaluator"]
+
+  boot_disk {
+    initialize_params {
+      image = "ubuntu-os-cloud/ubuntu-2204-lts"
+      size  = 50
+    }
+  }
+
+  network_interface {
+    network = "default"
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile("${path.module}/evaluator.sh", {
+    repo_url   = var.repo_url
+    env_id     = var.env_id
+    learner_ip = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
+  })
+}

@@ -1,0 +1,60 @@
+# Deployment knobs (reference: origin_repo/deploy/variables.tf +
+# terraform.tfvars: region, instance types, 48 nodes x 4 actors).
+
+variable "project" {
+  type        = string
+  description = "GCP project id"
+}
+
+variable "region" {
+  type    = string
+  default = "us-central2"
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central2-b"
+}
+
+variable "tpu_accelerator_type" {
+  type        = string
+  default     = "v4-8"
+  description = "Learner TPU slice (BASELINE.md north star: v4-8)"
+}
+
+variable "tpu_runtime_version" {
+  type    = string
+  default = "tpu-ubuntu2204-base"
+}
+
+variable "actor_node_count" {
+  type        = number
+  default     = 32
+  description = "CPU actor nodes (reference: 48)"
+}
+
+variable "actors_per_node" {
+  type        = number
+  default     = 8
+  description = "Actor processes per node (reference: 4; north star 32x8=256)"
+}
+
+variable "actor_machine_type" {
+  type    = string
+  default = "n2-standard-8"
+}
+
+variable "evaluator_machine_type" {
+  type    = string
+  default = "n2-standard-4"
+}
+
+variable "env_id" {
+  type    = string
+  default = "SeaquestNoFrameskip-v4"
+}
+
+variable "repo_url" {
+  type        = string
+  description = "Git URL of this framework, cloned by the bootstrap scripts"
+}
