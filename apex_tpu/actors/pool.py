@@ -207,6 +207,17 @@ class ActorPool:
         self.stat_queue: mp.Queue = ctx.Queue(maxsize=1024)
         self.param_queues = [ctx.Queue(maxsize=2) for _ in range(n)]
         self.stop_event = ctx.Event()
+        if cfg.actor.n_envs_per_actor > 1:
+            if worker_fn is not None:
+                # only the DQN family has a vector body; silently falling
+                # back to one env/process would run a 1/B-rate fleet with
+                # the wrong exploration spectrum
+                raise ValueError(
+                    "n_envs_per_actor > 1 requires the vectorized DQN "
+                    "worker; this pool was built with a custom worker_fn "
+                    f"({getattr(worker_fn, '__name__', worker_fn)})")
+            from apex_tpu.actors.vector import vector_worker_main
+            worker_fn = vector_worker_main   # B envs/process, batched policy
         eps = actor_epsilons(n, cfg.actor.eps_base, cfg.actor.eps_alpha)
         self.procs = [
             ctx.Process(

@@ -1,0 +1,216 @@
+"""Vectorized actor workers: B envs per process, one batched policy call.
+
+The reference runs exactly one env per actor process (``batchrecorder.py:79``,
+``origin_repo/actor.py:52-115``), so its "192 actors" cost 192 processes on
+48 nodes (``terraform.tfvars:4-5``).  On the TPU topology the policy is a
+jitted pure function that is *already batched* (``make_policy_fn`` vectorizes
+over the leading axis), so one process can drive B envs with a single
+forward per step — B actor slots for one interpreter, one model copy, and
+1/B-th the per-call dispatch overhead.  The 256-actor north star
+(BASELINE.json) becomes 8 processes x 32 envs instead of 256 processes.
+
+Semantics per env slot are IDENTICAL to the scalar worker
+(:mod:`apex_tpu.actors.pool`):
+
+* each slot has its own env, seed, :class:`FrameChunkBuilder`, and its own
+  epsilon from the global Ape-X ladder — the ladder spans ALL
+  ``n_actors * n_envs`` slots, so exploration diversity matches a fleet of
+  scalar actors (``batchrecorder.py:121``);
+* n-step windows, truncation bootstrapping, and acting-time TD priorities
+  are per-slot (one builder each);
+* param refresh stays CONFLATE latest-wins, polled every
+  ``update_interval`` *env* steps — i.e. every ``update_interval / B``
+  vector steps, so policy staleness measured in env frames is unchanged
+  (``actor.py:97-103``);
+* episode stats carry the global slot id, so the learner's logs can still
+  attribute rewards to an exploration level.
+
+Chunks from all slots ship on the same bounded queue; backpressure applies
+to the whole process (a full queue blocks all B slots — strictly stronger
+than the scalar fleet's per-process blocking, preserving the end-to-end
+flow control).
+"""
+
+from __future__ import annotations
+
+import math
+import queue as queue_lib
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig
+from apex_tpu.actors.pool import EpisodeStat
+
+
+class VectorDQNWorkerFamily:
+    """B-env DQN acting/recording: the vector counterpart of
+    :class:`apex_tpu.actors.pool.DQNWorkerFamily`."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
+                 slot_ids, epsilons, chunk_transitions: int):
+        import jax
+
+        from apex_tpu.envs.registry import make_env, unstacked_env_spec
+        from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+        from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+
+        self.cfg = cfg
+        self.seeds = list(seeds)
+        self.slot_ids = list(slot_ids)
+        self.epsilons = np.asarray(epsilons, np.float32)
+        self.n_envs = len(self.seeds)
+        assert self.n_envs == len(self.slot_ids) == len(self.epsilons)
+
+        self.envs = [
+            make_env(cfg.env.env_id, cfg.env, seed=s,
+                     max_episode_steps=cfg.actor.max_episode_length,
+                     stack_frames=False)
+            for s in self.seeds
+        ]
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            self.envs[0], cfg.env)
+        self.policy = jax.jit(make_policy_fn(DuelingDQN(**model_spec)))
+        self.builders = [
+            FrameChunkBuilder(
+                cfg.learner.n_steps, cfg.learner.gamma, frame_stack,
+                frame_shape, chunk_transitions=chunk_transitions,
+                frame_dtype=frame_dtype)
+            for _ in range(self.n_envs)
+        ]
+
+        # per-slot episode accounting
+        self.ep_reward = np.zeros(self.n_envs, np.float64)
+        self.ep_len = np.zeros(self.n_envs, np.int64)
+        self.slot_steps = np.zeros(self.n_envs, np.int64)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_all(self) -> None:
+        for env, builder, seed in zip(self.envs, self.builders, self.seeds):
+            obs, _ = env.reset(seed=seed)
+            builder.begin_episode(obs)
+
+    def close(self) -> None:
+        for env in self.envs:
+            env.close()
+
+    # -- stepping ----------------------------------------------------------
+
+    def _current_eps(self) -> np.ndarray:
+        anneal = self.cfg.actor.eps_anneal_steps
+        if not anneal:
+            return self.epsilons
+        decay = np.exp(-self.slot_steps / anneal)
+        return (self.epsilons + (1.0 - self.epsilons) * decay).astype(
+            np.float32)
+
+    def step_all(self, params, key) -> list[EpisodeStat]:
+        """One batched policy call, then one env.step per slot.  Returns
+        stats for slots whose episodes ended (those are auto-reset)."""
+        import jax.numpy as jnp
+
+        stacks = np.stack([b.current_stack() for b in self.builders])
+        actions, q = self.policy(params, stacks,
+                                 jnp.asarray(self._current_eps()), key)
+        actions = np.asarray(actions)
+        q = np.asarray(q)
+
+        stats: list[EpisodeStat] = []
+        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
+            a = int(actions[i])
+            next_obs, reward, term, trunc, _ = env.step(a)
+            builder.add_step(a, float(reward), q[i], next_obs,
+                             bool(term), bool(trunc))
+            self.ep_reward[i] += float(reward)
+            self.ep_len[i] += 1
+            self.slot_steps[i] += 1
+            if term or trunc:
+                stats.append(EpisodeStat(self.slot_ids[i],
+                                         float(self.ep_reward[i]),
+                                         int(self.ep_len[i])))
+                self.ep_reward[i] = 0.0
+                self.ep_len[i] = 0
+                obs, _ = env.reset()
+                builder.begin_episode(obs)
+        return stats
+
+    def poll_msgs(self) -> list[dict]:
+        out = []
+        for builder in self.builders:
+            for chunk in builder.poll():
+                out.append({"payload": chunk,
+                            "priorities": chunk.pop("priorities"),
+                            "n_trans": int(chunk["n_trans"])})
+        return out
+
+
+def vector_worker_loop(actor_id: int, cfg: ApexConfig,
+                       family: VectorDQNWorkerFamily, chunk_queue,
+                       param_queue, stat_queue, stop_event) -> None:
+    """Vector counterpart of :func:`apex_tpu.actors.pool.worker_loop`: the
+    same lifecycle (interruptible first-publish wait, CONFLATE param polls,
+    chunk backpressure, clean shutdown) over B env slots."""
+    import jax
+
+    key = jax.random.key(family.seeds[0])
+    version, params = 0, None
+    while True:                                  # block for first publish
+        if stop_event.is_set():
+            family.close()
+            return
+        try:
+            version, params = param_queue.get(timeout=0.5)
+            break
+        except queue_lib.Empty:
+            continue
+
+    # poll cadence in VECTOR steps so staleness in env frames matches the
+    # scalar worker's update_interval
+    poll_every = max(1, math.ceil(cfg.actor.update_interval / family.n_envs))
+    steps_since_poll = 0
+    family.reset_all()
+
+    while not stop_event.is_set():
+        steps_since_poll += 1
+        if steps_since_poll >= poll_every:
+            steps_since_poll = 0
+            try:
+                while True:                      # keep only the newest
+                    version, params = param_queue.get_nowait()
+            except queue_lib.Empty:
+                pass
+
+        key, akey = jax.random.split(key)
+        for stat in family.step_all(params, akey):
+            stat.param_version = version
+            try:
+                stat_queue.put_nowait(stat)
+            except queue_lib.Full:
+                pass
+
+        for msg in family.poll_msgs():
+            chunk_queue.put(("chunk", actor_id, msg))     # blocks when full
+
+    family.close()
+
+
+def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                       chunk_queue, param_queue, stat_queue, stop_event,
+                       epsilon: float, chunk_transitions: int) -> None:
+    """Process body wired through :class:`~apex_tpu.actors.pool.ActorPool`'s
+    scalar ``worker_fn`` signature: ``epsilon`` is ignored — the family
+    re-derives its slots' epsilons from the GLOBAL ladder so the fleet's
+    exploration spectrum is identical whether slots are processes or vector
+    lanes."""
+    from apex_tpu.actors.pool import actor_epsilons
+
+    b = cfg.actor.n_envs_per_actor
+    total = cfg.actor.n_actors * b
+    ladder = actor_epsilons(total, cfg.actor.eps_base, cfg.actor.eps_alpha)
+    slot_ids = list(range(actor_id * b, (actor_id + 1) * b))
+    seeds = [cfg.env.seed + 1000 * (s + 1) for s in slot_ids]
+    family = VectorDQNWorkerFamily(
+        cfg, model_spec, seeds=seeds, slot_ids=slot_ids,
+        epsilons=ladder[slot_ids], chunk_transitions=chunk_transitions)
+    vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
+                       stat_queue, stop_event)

@@ -78,6 +78,12 @@ class ActorConfig:
     """Actor-fleet hyperparameters (reference: arguments.py:9-40, batchrecorder.py:121)."""
 
     n_actors: int = 8
+    # Env slots driven by EACH worker process through one batched policy
+    # call per step (apex_tpu/actors/vector.py).  The exploration ladder
+    # spans all n_actors * n_envs_per_actor slots, so 8 x 32 reproduces the
+    # exploration spectrum of 256 scalar actor processes.  1 = the
+    # reference's one-env-per-process topology (batchrecorder.py:79).
+    n_envs_per_actor: int = 1
     send_interval: int = 50          # transitions per shipped batch
     update_interval: int = 400       # env steps between param refresh polls
     eps_base: float = 0.4            # per-actor ladder eps_base^(1 + i/(N-1)*eps_alpha)

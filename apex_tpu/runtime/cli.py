@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
     # RoleIdentity.from_env above is the canonical reader, flags win)
     p.add_argument("--actor-id", type=int, default=ident.actor_id)
     p.add_argument("--n-actors", type=int, default=ident.n_actors)
+    p.add_argument("--n-envs-per-actor", type=int,
+                   default=int(e.get("N_ENVS_PER_ACTOR", 1)),
+                   help="env slots per actor process, driven through one "
+                        "batched policy call; the exploration ladder spans "
+                        "n_actors * n_envs_per_actor slots (8 x 32 = the "
+                        "256-actor spectrum in 8 processes)")
     p.add_argument("--n-evaluators", type=int,
                    default=int(e.get("N_EVALUATORS", 1)))
     p.add_argument("--learner-ip", default=ident.learner_ip)
@@ -122,7 +128,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                               args.target_update_interval,
                               save_interval=args.save_interval,
                               mesh_shape=_mesh_shape(args)),
-        actor=ActorConfig(n_actors=args.n_actors),
+        actor=ActorConfig(n_actors=args.n_actors,
+                          n_envs_per_actor=args.n_envs_per_actor),
         aql=AQLConfig(),
     )
 

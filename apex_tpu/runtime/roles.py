@@ -144,7 +144,19 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
         worker_fn, model_spec = _worker_main, dqn_model_spec(cfg)
+        if cfg.actor.n_envs_per_actor > 1:
+            from apex_tpu.actors.vector import vector_worker_main
+            worker_fn = vector_worker_main
+            # the vector family re-derives its slots' epsilons from the
+            # ladder over cfg.actor.n_actors * n_envs_per_actor — align the
+            # config with the FLEET size the deploy scripts put in the
+            # identity (actor.py:18-25)
+            cfg = cfg.replace(actor=dataclasses.replace(
+                cfg.actor, n_actors=identity.n_actors))
     elif family == "aql":
+        if cfg.actor.n_envs_per_actor > 1:
+            raise ValueError("n_envs_per_actor > 1 is DQN-only for now; "
+                             "the AQL family has no vector worker body")
         from apex_tpu.actors.aql import aql_worker_main
         from apex_tpu.envs.registry import make_env
         from apex_tpu.training.aql import aql_model_spec

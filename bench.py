@@ -114,10 +114,11 @@ def bench_fused_step() -> dict:
         jax.block_until_ready(metrics["loss"])
         rates.append(MEASURE_STEPS / (time.perf_counter() - t0))
 
-    from apex_tpu.utils.profiling import flops_per_call, mfu
+    from apex_tpu.utils.profiling import DEFAULT_PEAK, flops_per_call, mfu
     flops = flops_per_call(fused, ts, rs, chunk, prios, jax.random.key(0),
                            jnp.float32(0.4))
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12
+    peak = (float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+            if "BENCH_PEAK_TFLOPS" in os.environ else DEFAULT_PEAK)
     util = mfu(flops, float(np.median(rates)), peak)
     return {"median": float(np.median(rates)),
             "min": round(min(rates), 2), "max": round(max(rates), 2),

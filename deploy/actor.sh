@@ -12,6 +12,7 @@ while [ $idx -lt ${actors_per_node} ]; do
   ACTOR_ID=$(( ${node_id} * ${actors_per_node} + idx ))
   tmux new -s "actor-$ACTOR_ID" -d \
     "JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
+     N_ENVS_PER_ACTOR=${envs_per_actor} \
      LEARNER_IP=${learner_ip} python -m apex_tpu.runtime \
      --env-id ${env_id} --barrier-timeout 1800; read"
   idx=$(( idx + 1 ))

@@ -81,6 +81,7 @@ resource "google_compute_instance" "actor" {
     env_id          = var.env_id
     node_id         = count.index
     actors_per_node = var.actors_per_node
+    envs_per_actor  = var.envs_per_actor
     n_actors        = var.actor_node_count * var.actors_per_node
     learner_ip      = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
   })

@@ -39,6 +39,12 @@ variable "actors_per_node" {
   description = "Actor processes per node (reference: 4; north star 32x8=256)"
 }
 
+variable "envs_per_actor" {
+  type        = number
+  default     = 1
+  description = "Env slots per actor process behind one batched policy call; raise to multiply fleet frames/s without more processes (ladder spans n_actors * envs_per_actor)"
+}
+
 variable "actor_machine_type" {
   type    = string
   default = "n2-standard-8"

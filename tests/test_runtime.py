@@ -118,3 +118,10 @@ def test_cli_parser_roles_and_env_twins(monkeypatch):
     # flags beat env vars
     args2 = build_parser().parse_args(["--role", "evaluator"])
     assert args2.role == "evaluator"
+    # vector actors reachable from the CLI and its env-var twin
+    monkeypatch.setenv("N_ENVS_PER_ACTOR", "16")
+    cfg3 = config_from_args(build_parser().parse_args([]))
+    assert cfg3.actor.n_envs_per_actor == 16
+    cfg4 = config_from_args(
+        build_parser().parse_args(["--n-envs-per-actor", "32"]))
+    assert cfg4.actor.n_envs_per_actor == 32
