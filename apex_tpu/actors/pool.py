@@ -199,11 +199,12 @@ class ActorPool:
 
     def __init__(self, cfg: ApexConfig, model_spec: dict,
                  chunk_transitions: int, chunk_queue_depth: int = 64,
-                 worker_fn=None):
+                 worker_fn=None, shm_slot_bytes: int | None = None):
         self.cfg = cfg
         n = cfg.actor.n_actors
         ctx = mp.get_context("spawn")
-        self.chunk_queue: mp.Queue = ctx.Queue(maxsize=chunk_queue_depth)
+        self.chunk_queue = self._make_chunk_queue(
+            cfg, chunk_queue_depth, shm_slot_bytes, ctx)
         self.stat_queue: mp.Queue = ctx.Queue(maxsize=1024)
         self.param_queues = [ctx.Queue(maxsize=2) for _ in range(n)]
         self.stop_event = ctx.Event()
@@ -228,6 +229,25 @@ class ActorPool:
                 daemon=True)
             for i in range(n)
         ]
+
+    @staticmethod
+    def _make_chunk_queue(cfg: ApexConfig, depth: int,
+                          shm_slot_bytes: int | None, ctx):
+        """The chunk plane: native shared-memory ring when available
+        (:mod:`apex_tpu.native`), else mp.Queue.  Same bounded-queue
+        backpressure either way."""
+        if cfg.actor.shm_data_plane:
+            from apex_tpu.native import shm_available
+            if shm_available():
+                from apex_tpu.native.ring import ShmChunkQueue
+                slot = (cfg.actor.shm_slot_bytes
+                        or shm_slot_bytes or 4 * 1024 * 1024)
+                name = f"apexshm-{os.getpid()}-{ShmChunkQueue.next_id()}"
+                try:
+                    return ShmChunkQueue(name, slot_bytes=slot, depth=depth)
+                except Exception:
+                    pass      # tmpfs full / permissions: degrade to mp.Queue
+        return ctx.Queue(maxsize=depth)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -194,14 +194,12 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig,
     family.close()
 
 
-def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
-                       chunk_queue, param_queue, stat_queue, stop_event,
-                       epsilon: float, chunk_transitions: int) -> None:
-    """Process body wired through :class:`~apex_tpu.actors.pool.ActorPool`'s
-    scalar ``worker_fn`` signature: ``epsilon`` is ignored — the family
-    re-derives its slots' epsilons from the GLOBAL ladder so the fleet's
-    exploration spectrum is identical whether slots are processes or vector
-    lanes."""
+def worker_slots(cfg: ApexConfig, actor_id: int):
+    """Pure slot derivation for one vector worker: ``(slot_ids, seeds,
+    epsilons)``.  The ladder spans the WHOLE fleet
+    (``n_actors * n_envs_per_actor`` slots) and worker ``i`` owns the
+    contiguous band ``[i*B, (i+1)*B)`` — seeds match what a fleet of scalar
+    workers with those global ids would use."""
     from apex_tpu.actors.pool import actor_epsilons
 
     b = cfg.actor.n_envs_per_actor
@@ -209,8 +207,20 @@ def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
     ladder = actor_epsilons(total, cfg.actor.eps_base, cfg.actor.eps_alpha)
     slot_ids = list(range(actor_id * b, (actor_id + 1) * b))
     seeds = [cfg.env.seed + 1000 * (s + 1) for s in slot_ids]
+    return slot_ids, seeds, ladder[slot_ids]
+
+
+def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                       chunk_queue, param_queue, stat_queue, stop_event,
+                       epsilon: float, chunk_transitions: int) -> None:
+    """Process body wired through :class:`~apex_tpu.actors.pool.ActorPool`'s
+    scalar ``worker_fn`` signature: ``epsilon`` is ignored — the family
+    re-derives its slots' epsilons from the GLOBAL ladder
+    (:func:`worker_slots`) so the fleet's exploration spectrum is identical
+    whether slots are processes or vector lanes."""
+    slot_ids, seeds, epsilons = worker_slots(cfg, actor_id)
     family = VectorDQNWorkerFamily(
-        cfg, model_spec, seeds=seeds, slot_ids=slot_ids,
-        epsilons=ladder[slot_ids], chunk_transitions=chunk_transitions)
+        cfg, model_spec, seeds=seeds, slot_ids=slot_ids, epsilons=epsilons,
+        chunk_transitions=chunk_transitions)
     vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
                        stat_queue, stop_event)

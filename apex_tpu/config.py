@@ -97,6 +97,14 @@ class ActorConfig:
     # None = the env's own limit; reference Atari deployments use 50_000
     # (wrapper.py:282-298 TimeLimit via arguments.py max_episode_length)
     max_episode_length: int | None = None
+    # In-host chunk transport: the native shared-memory ring
+    # (apex_tpu/native/) when it is buildable, else mp.Queue.  The reference
+    # always pays mp.Queue's pickle->pipe->feeder-thread copies
+    # (batchrecorder.py:111-112).
+    shm_data_plane: bool = True
+    # Ring slot size; 0 = drivers compute it from the frame spec (or a 4MiB
+    # default when they can't).  A chunk message must fit one slot.
+    shm_slot_bytes: int = 0
 
 
 @dataclass(frozen=True)

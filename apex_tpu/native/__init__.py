@@ -1,0 +1,95 @@
+"""First-party native runtime components (C++, ctypes-bound).
+
+The TPU compute path is JAX/XLA; the host runtime around it is native where
+the hot path justifies it.  Today that is the in-host actor->learner data
+plane: :mod:`apex_tpu.native.ring` replaces ``multiprocessing.Queue``'s
+pickle->pipe->feeder-thread hops with a shared-memory MPSC ring
+(``shm_ring.cpp``).
+
+The library builds on demand with the image's ``g++`` (no pybind11 — plain
+C ABI + ctypes) into ``_build/``; anything that can fail (no compiler, no
+/dev/shm) degrades gracefully: callers check :func:`shm_available` and fall
+back to ``mp.Queue``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "shm_ring.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB = os.path.join(_BUILD_DIR, "libapexshm.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    """Compile the ring if the .so is missing or older than the source.
+    Returns an error string, or None on success."""
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return None
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = _LIB + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+               _SRC, "-lrt", "-lpthread"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            return f"g++ failed: {proc.stderr[-2000:]}"
+        os.replace(tmp, _LIB)  # atomic: concurrent builders don't torn-read
+        return None
+    except Exception as e:  # missing g++, read-only tree, ...
+        return f"{type(e).__name__}: {e}"
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        lib.apex_shm_create.restype = ctypes.c_void_p
+        lib.apex_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+        lib.apex_shm_open.restype = ctypes.c_void_p
+        lib.apex_shm_open.argtypes = [ctypes.c_char_p]
+        lib.apex_shm_close.argtypes = [ctypes.c_void_p]
+        lib.apex_shm_push.restype = ctypes.c_int
+        lib.apex_shm_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int]
+        lib.apex_shm_pop.restype = ctypes.c_int64
+        lib.apex_shm_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int]
+        for fn in ("apex_shm_dropped", "apex_shm_pending",
+                   "apex_shm_slot_size"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def shm_available() -> bool:
+    """True when the native ring compiled, loads, and /dev/shm works."""
+    return _load() is not None and os.path.isdir("/dev/shm")
+
+
+def build_error() -> str | None:
+    """Why the native library is unavailable (None if it is, or untried)."""
+    _load()
+    return _build_error

@@ -1,0 +1,180 @@
+"""Python bindings for the shared-memory MPSC ring (``shm_ring.cpp``).
+
+Two layers:
+
+* :class:`ShmRing` — thin ctypes wrapper over the C ABI (bytes in/out).
+* :class:`ShmChunkQueue` — the mp.Queue-shaped facade
+  :class:`apex_tpu.actors.pool.ActorPool` uses for its chunk plane: same
+  ``put / get / get_nowait / close / cancel_join_thread`` surface, same
+  blocking-when-full backpressure, but the payload crosses process
+  boundaries through one shared-memory copy instead of pickle->pipe->
+  feeder-thread.  Messages are pickled (protocol 5) like the wire format
+  everywhere else in the runtime; the win is the transport, not the codec.
+
+The facade pickles cleanly: children receive only the segment name and
+re-open the ring lazily on first use (the C side maps the same physical
+pages).  The CREATOR process (the learner) owns the segment and unlinks it
+on close.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import queue as queue_lib
+
+from apex_tpu import native
+
+
+class ShmRingError(RuntimeError):
+    pass
+
+
+class ShmRing:
+    """One shared-memory ring: many producers, one consumer."""
+
+    def __init__(self, name: str, slot_size: int = 0, n_slots: int = 0,
+                 create: bool = False):
+        lib = native._load()
+        if lib is None:
+            raise ShmRingError(f"native ring unavailable: "
+                               f"{native.build_error()}")
+        if not name.startswith("/"):
+            name = "/" + name
+        self.name = name
+        self._lib = lib
+        if create:
+            if slot_size <= 8 or n_slots <= 0:
+                raise ValueError("create needs slot_size > 8 and n_slots > 0")
+            self._h = lib.apex_shm_create(name.encode(), slot_size, n_slots)
+        else:
+            self._h = lib.apex_shm_open(name.encode())
+        if not self._h:
+            raise ShmRingError(f"could not {'create' if create else 'open'} "
+                               f"shm ring {name!r}")
+        self.slot_size = int(lib.apex_shm_slot_size(self._h))
+        self._buf = ctypes.create_string_buffer(self.slot_size)
+
+    # -- raw ops -----------------------------------------------------------
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        """False on timeout (ring full).  Raises when ``data`` can never
+        fit a slot."""
+        rc = self._lib.apex_shm_push(self._h, data, len(data), timeout_ms)
+        if rc == -2:
+            raise ShmRingError(
+                f"message of {len(data)} bytes exceeds slot size "
+                f"{self.slot_size} (raise ActorConfig.shm_slot_bytes)")
+        return rc == 0
+
+    def pop(self, timeout_ms: int = 0) -> bytes | None:
+        """Next message, or None on timeout."""
+        rc = self._lib.apex_shm_pop(self._h, self._buf,
+                                    self.slot_size, timeout_ms)
+        if rc == -2:  # cannot happen: _buf is slot-sized
+            raise ShmRingError("pop buffer smaller than slot")
+        if rc < 0:
+            return None
+        return self._buf.raw[:rc]
+
+    def pending(self) -> int:
+        return int(self._lib.apex_shm_pending(self._h))
+
+    def push_timeouts(self) -> int:
+        """Cumulative push timeout returns — BACKPRESSURE events (a full
+        ring made a producer wait out a slice), not lost messages; blocking
+        callers retry and nothing is dropped."""
+        return int(self._lib.apex_shm_dropped(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.apex_shm_close(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; close() is the real path
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmChunkQueue:
+    """mp.Queue facade over :class:`ShmRing` for the ActorPool chunk plane.
+
+    The parent constructs it (``create=True`` — owns/unlinks the segment);
+    worker processes get a pickled copy holding only the name and re-open
+    lazily.  ``put`` blocks while the ring is full, in 200ms slices so a
+    terminated consumer never wedges a worker harder than mp.Queue would.
+    """
+
+    _counter = 0
+
+    @classmethod
+    def next_id(cls) -> int:
+        """Process-local id for unique segment names (one per pool)."""
+        cls._counter += 1
+        return cls._counter
+
+    def __init__(self, name: str, slot_bytes: int, depth: int):
+        self.name = name
+        self.slot_bytes = slot_bytes
+        self.depth = depth
+        self._ring: ShmRing | None = ShmRing(
+            name, slot_size=slot_bytes, n_slots=depth, create=True)
+        self._owner = True
+
+    # -- pickling into workers --------------------------------------------
+
+    def __getstate__(self):
+        return {"name": self.name, "slot_bytes": self.slot_bytes,
+                "depth": self.depth}
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._ring = None          # re-open lazily in the child
+        self._owner = False
+
+    def _open(self) -> ShmRing:
+        if self._ring is None:
+            self._ring = ShmRing(self.name)
+        return self._ring
+
+    # -- mp.Queue surface used by pool.py / roles adapters -----------------
+
+    def put(self, item) -> None:
+        data = pickle.dumps(item, protocol=5)
+        ring = self._open()
+        while not ring.push(data, timeout_ms=200):
+            pass                   # full: keep blocking, like mp.Queue.put
+
+    def get(self, timeout: float = 0.0):
+        got = self._open().pop(timeout_ms=max(1, int(timeout * 1000)))
+        if got is None:
+            raise queue_lib.Empty
+        return pickle.loads(got)
+
+    def get_nowait(self):
+        got = self._open().pop(timeout_ms=0)
+        if got is None:
+            raise queue_lib.Empty
+        return pickle.loads(got)
+
+    def pending(self) -> int:
+        return self._open().pending()
+
+    def cancel_join_thread(self) -> None:   # no feeder thread to detach
+        pass
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+
+def chunk_slot_bytes(frame_dim: int, frame_dtype_size: int, kf: int,
+                     k: int, stack: int, margin: int = 65536) -> int:
+    """Conservative slot size for a frame-chunk message: the frames array
+    dominates; transition fields and pickle framing ride in the margin."""
+    frames = kf * frame_dim * frame_dtype_size
+    trans = k * (2 * stack + 3) * 4 + k * 4
+    return frames + trans + margin

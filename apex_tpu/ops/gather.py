@@ -1,0 +1,138 @@
+"""Pallas TPU kernel for the replay frame-stack gather.
+
+The hottest data movement in the fused learner step is sample-time stack
+reconstruction (:meth:`apex_tpu.replay.frame_pool.FramePoolReplay.sample`):
+``2 * B * S`` random rows of the HBM frame ring — for the reference config
+(B=512, S=4, 84x84 frames) ~29MB of data-dependent gather per step.  XLA
+lowers ``frames[ids]`` to a generic dynamic-gather; this kernel instead
+streams each row with an explicit double-buffered DMA driven by
+scalar-prefetched indices (the embedding-lookup pattern from the pallas
+guide): the row ids land in SMEM before the kernel body runs, so every
+grid step issues its next row fetch while the previous one is in flight,
+and the row bytes move HBM -> VMEM exactly once.
+
+The kernel is TPU-only; :func:`gather_rows` dispatches on the platform of
+the ``frames`` buffer — ``jnp.take`` everywhere else (CPU CI, the virtual
+mesh) — and parity is pinned by ``tests/test_gather.py`` in interpret mode.
+
+Mosaic constrains DMA slices of 2-D buffers to (8, 128)-tile boundaries, so
+single-row slices of ``[F, D]`` only lower when each row is itself a whole
+number of tiles: rows must span a multiple of ``ROW_UNIT = 8 * 128``
+elements.  :class:`~apex_tpu.replay.frame_pool.FramePoolReplay` pads its
+ring rows to this unit for pixel frames (84x84 -> 7168, +1.6%); the kernel
+then views the ring as ``[F, 8, D/8]`` and slices dim 0, which carries no
+tiling constraint.  Ineligible layouts (tiny vector obs, odd dtypes) fall
+back to ``jnp.take`` in auto mode.
+
+Reference analogue: the torch side pays this cost in
+``_encode_sample``'s host-side ``np.stack`` of LazyFrames
+(``memory.py:348-362``) — per-sample Python decompression on the replay
+host.  Here it is one compiled device op either way; the kernel removes
+XLA's gather overhead on top.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# one (8, 128) tile, in elements: the row-size quantum the kernel needs
+ROW_UNIT = 8 * 128
+
+# rows DMA'd per grid step (row count padded up to a multiple): enough
+# in-flight transfers to amortize per-row DMA latency; the VMEM out block
+# stays small (32 * 7168B = 229KB for Atari rows)
+_GROUP = 32
+
+
+def _gather_kernel(ids_ref, frames_ref, out_ref, sems):
+    """One grid step DMAs _GROUP rows HBM->VMEM: start all, then drain, so
+    the row-fetch latencies overlap each other, and Mosaic's grid pipeline
+    overlaps this step's fetches with the previous block's writeback.
+    Refs are 3-D ``[rows, 8, D/8]`` — the sliced dim sits outside the
+    (8, 128)-tiled trailing pair, so single-row slices lower cleanly
+    (slicing a 2-D ``[F, D]`` ref one row at a time does not: Mosaic
+    requires tile-aligned slices in the trailing two dims)."""
+    i = pl.program_id(0)
+    copies = []
+    for j in range(_GROUP):
+        row = ids_ref[i * _GROUP + j]
+        cp = pltpu.make_async_copy(
+            frames_ref.at[pl.ds(row, 1)],
+            out_ref.at[pl.ds(j, 1)],
+            sems.at[j])
+        cp.start()
+        copies.append(cp)
+    for cp in copies:
+        cp.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_gather(frames3: jax.Array, ids: jax.Array,
+                   interpret: bool = False) -> jax.Array:
+    """``frames3`` MUST already be the tiled 3-D view ``[F, 8, D/8]`` —
+    reshaping a 2-D ring inside the same jit makes XLA materialize a copy
+    of the whole ring as the custom-call operand, which costs more than the
+    gather itself.  FramePoolReplay therefore STORES its ring 3-D."""
+    n, (f, _, c) = ids.shape[0], frames3.shape
+    pad = (-n) % _GROUP
+    ids_padded = jnp.pad(ids, (0, pad))         # extra rows cut off below
+    grid = (ids_padded.shape[0] // _GROUP,)
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # ring in HBM
+            out_specs=pl.BlockSpec((_GROUP, 8, c),
+                                   lambda i, ids: (i, 0, 0)),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((_GROUP,))],
+        ),
+        out_shape=jax.ShapeDtypeStruct((ids_padded.shape[0], 8, c),
+                                       frames3.dtype),
+        interpret=interpret,
+    )(ids_padded, frames3)
+    return out.reshape(-1, 8 * c)[:n]
+
+
+def _on_tpu(x: jax.Array) -> bool:
+    try:
+        return list(x.devices())[0].platform == "tpu"
+    except Exception:        # tracers under jit: ask the default backend
+        return jax.default_backend() == "tpu"
+
+
+def pallas_eligible(d: int, dtype) -> bool:
+    """Row layouts the TPU kernel can slice: whole (8, 128) tiles.
+    FramePoolReplay pads pixel rows to satisfy this.  (bf16's (16, 128)
+    native tile doesn't fit the 8-sublane row view — frames are u8/f32.)"""
+    return d % ROW_UNIT == 0 and jnp.dtype(dtype).itemsize in (1, 4)
+
+
+def gather_rows(frames: jax.Array, ids: jax.Array,
+                mode: str = "auto") -> jax.Array:
+    """Row gather from a frame ring; returns flat rows ``[N, D]``.
+
+    ``frames`` is either the flat ring ``[F, D]`` or the tiled 3-D view
+    ``[F, 8, D/8]`` the pallas kernel needs (what FramePoolReplay stores
+    for pixel frames).  mode: ``auto`` = pallas kernel on TPU for tiled
+    eligible rings, ``jnp.take`` elsewhere; ``pallas`` / ``interpret`` /
+    ``xla`` force a path (tests, benches).
+    """
+    d = math.prod(frames.shape[1:])
+    if mode == "auto":
+        mode = ("pallas" if frames.ndim == 3 and _on_tpu(frames)
+                and pallas_eligible(d, frames.dtype) else "xla")
+    if mode in ("pallas", "interpret"):
+        if d % 8:
+            raise ValueError(
+                f"pallas gather needs row dim % 8 == 0, got {d}")
+        f3 = (frames if frames.ndim == 3
+              else frames.reshape(frames.shape[0], 8, d // 8))
+        return _pallas_gather(f3, ids, interpret=(mode == "interpret"))
+    return jnp.take(frames, ids, axis=0).reshape(ids.shape[0], d)

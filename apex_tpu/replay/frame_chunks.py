@@ -35,6 +35,11 @@ from collections import deque
 
 import numpy as np
 
+# Extra frame rows per chunk beyond one per transition (episode carry +
+# reset frames).  Shm slot sizing (training/apex.py) derives each chunk's
+# Kf from this same constant — a chunk must fit one ring slot.
+FRAME_MARGIN = 16
+
 
 class FrameChunkBuilder:
     """One builder per env slot (like the per-actor BatchStorage)."""
@@ -42,7 +47,7 @@ class FrameChunkBuilder:
     def __init__(self, n_steps: int, gamma: float, frame_stack: int,
                  frame_shape: tuple[int, ...],
                  chunk_transitions: int = 64,
-                 frame_margin: int = 16,
+                 frame_margin: int = FRAME_MARGIN,
                  frame_dtype=np.uint8):
         self.n = n_steps
         self.gamma = gamma

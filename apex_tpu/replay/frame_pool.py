@@ -97,6 +97,9 @@ class FramePoolReplay(PERMethods):
     frame_dtype: str = "uint8"
     alpha: float = 0.6
     eps: float = 1e-6
+    # Frame-row gather backend: "auto" = the pallas scalar-prefetch DMA
+    # kernel on TPU (apex_tpu/ops/gather.py), jnp.take elsewhere.
+    gather_mode: str = "auto"
 
     def __post_init__(self):
         tree_ops._check_capacity(self.capacity)
@@ -112,7 +115,7 @@ class FramePoolReplay(PERMethods):
         mis-sized config fails with an actionable error instead of an opaque
         XLA OOM."""
         c, s = self.capacity, self.frame_stack
-        frame_bytes = (self.f_capacity * self.frame_dim
+        frame_bytes = (self.f_capacity * self.row_dim
                        * jnp.dtype(self.frame_dtype).itemsize)
         # action/reward/discount/frame_epoch i32|f32 + 2 id tables + 2 trees
         per_trans = 4 * 4 + 2 * 4 * s
@@ -128,6 +131,29 @@ class FramePoolReplay(PERMethods):
     def frame_dim(self) -> int:
         return math.prod(self.frame_shape)
 
+    @property
+    def row_dim(self) -> int:
+        """Stored row width: pixel rows pad up to whole (8, 128) tiles so
+        the pallas gather kernel can DMA single rows (ops/gather.py module
+        docstring); 84x84 pads 7056 -> 7168 (+1.6%).  Small vector rows
+        stay unpadded — they take the XLA gather path."""
+        from apex_tpu.ops.gather import ROW_UNIT, pallas_eligible
+        d = self.frame_dim
+        padded = -(-d // ROW_UNIT) * ROW_UNIT
+        if d >= ROW_UNIT // 2 and pallas_eligible(padded, self.frame_dtype):
+            return padded
+        return d
+
+    @property
+    def ring_shape(self) -> tuple[int, ...]:
+        """Padded rings are STORED in the kernel's tiled 3-D view
+        ``(F, 8, row_dim/8)``: handing the kernel a pre-shaped operand is
+        what keeps the pallas call zero-copy (reshaping inside the fused
+        jit step would materialize the whole ring per step)."""
+        if self.row_dim != self.frame_dim:
+            return (self.f_capacity, 8, self.row_dim // 8)
+        return (self.f_capacity, self.row_dim)
+
     # -- construction ------------------------------------------------------
 
     def init(self, example_item=None) -> FramePoolState:
@@ -135,8 +161,7 @@ class FramePoolReplay(PERMethods):
         with :meth:`DeviceReplay.init` (shapes come from the spec)."""
         c, s = self.capacity, self.frame_stack
         return FramePoolState(
-            frames=jnp.zeros((self.f_capacity, self.frame_dim),
-                             jnp.dtype(self.frame_dtype)),
+            frames=jnp.zeros(self.ring_shape, jnp.dtype(self.frame_dtype)),
             action=jnp.zeros(c, jnp.int32),
             reward=jnp.zeros(c, jnp.float32),
             discount=jnp.zeros(c, jnp.float32),
@@ -191,7 +216,11 @@ class FramePoolReplay(PERMethods):
         frow = jnp.minimum(jnp.arange(kf, dtype=jnp.int32),
                            chunk["n_frames"] - 1)
         fidx = (fpos + frow) % f
-        frames = state.frames.at[fidx].set(chunk["frames"])
+        rows = chunk["frames"]
+        if self.row_dim != self.frame_dim:       # tile-align (see row_dim)
+            rows = jnp.pad(rows, ((0, 0), (0, self.row_dim - self.frame_dim)))
+            rows = rows.reshape(kf, 8, self.row_dim // 8)
+        frames = state.frames.at[fidx].set(rows)
 
         trow = jnp.minimum(jnp.arange(k, dtype=jnp.int32),
                            chunk["n_trans"] - 1)
@@ -252,9 +281,12 @@ class FramePoolReplay(PERMethods):
                        ids: jax.Array) -> jax.Array:
         """(B, S) frame-ring rows -> (B, *shape[:-1], S*shape[-1]),
         oldest frame first on the last axis."""
+        from apex_tpu.ops.gather import gather_rows
         b, s = ids.shape
         shape = self.frame_shape
-        rows = state.frames[ids.reshape(-1)]            # (B*S, D)
+        rows = gather_rows(state.frames, ids.reshape(-1),
+                           mode=self.gather_mode)       # (B*S, row_dim)
+        rows = rows[:, :self.frame_dim]                 # drop tile padding
         rows = rows.reshape(b, s, *shape)
         rows = jnp.moveaxis(rows, 1, -2)                # stack before channel
         return rows.reshape(b, *shape[:-1], s * shape[-1])

@@ -281,8 +281,19 @@ class ApexTrainer(ConcurrentTrainer):
 
         # pool injection: the multi-host learner passes a socket-backed
         # RemotePool; default is the in-host process pool
-        self.pool = pool if pool is not None else ActorPool(
-            cfg, self.model_spec, chunk_transitions=cfg.actor.send_interval)
+        if pool is not None:
+            self.pool = pool
+        else:
+            from apex_tpu.native.ring import chunk_slot_bytes
+            from apex_tpu.replay.frame_chunks import FRAME_MARGIN
+            slot = chunk_slot_bytes(
+                frame_dim=int(np.prod(frame_shape)),
+                frame_dtype_size=np.dtype(frame_dtype).itemsize,
+                kf=cfg.actor.send_interval + FRAME_MARGIN,
+                k=cfg.actor.send_interval, stack=frame_stack)
+            self.pool = ActorPool(cfg, self.model_spec,
+                                  chunk_transitions=cfg.actor.send_interval,
+                                  shm_slot_bytes=slot)
 
         self.n_dp = int(np.prod(lc.mesh_shape))
         if self.n_dp > 1:

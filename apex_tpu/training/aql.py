@@ -440,10 +440,20 @@ class AQLApexTrainer(ConcurrentTrainer):
         eval_model = self.model.clone(noisy_deterministic=True)
         self._eval_policy = jax.jit(make_aql_policy_fn(eval_model))
 
-        self.pool = pool if pool is not None else ActorPool(
-            cfg, self.model_spec,
-            chunk_transitions=cfg.actor.send_interval,
-            worker_fn=aql_worker_main)
+        if pool is not None:
+            self.pool = pool
+        else:
+            # AQL chunks: K x (obs + next_obs + a_mu + scalars), far below
+            # the pixel default — size the ring slot from the actual spec
+            k = cfg.actor.send_interval
+            obs_bytes = (int(np.prod(obs_shape))
+                         * np.dtype(obs_dtype).itemsize)
+            act_dim = self.model_spec["action_dim"]
+            slot = k * (2 * obs_bytes + 4 * act_dim + 32) + 65536
+            self.pool = ActorPool(
+                cfg, self.model_spec,
+                chunk_transitions=cfg.actor.send_interval,
+                worker_fn=aql_worker_main, shm_slot_bytes=slot)
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()

@@ -1,0 +1,136 @@
+"""Pallas frame-gather kernel: interpret-mode parity against the XLA path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.gather import gather_rows
+
+
+@pytest.mark.parametrize("n,f,d,dtype", [
+    (32, 64, 256, jnp.uint8),       # aligned lanes
+    (13, 16, 2048, jnp.uint8),      # padded 42x42 rows, group padding
+    (48, 128, 136, jnp.float32),    # lane-unaligned (d%8==0) vector rows
+])
+def test_pallas_gather_matches_xla(n, f, d, dtype):
+    key = jax.random.key(0)
+    frames = jax.random.randint(key, (f, d), 0, 255).astype(dtype)
+    ids = jax.random.randint(jax.random.key(1), (n,), 0, f, jnp.int32)
+    want = gather_rows(frames, ids, mode="xla")
+    got = gather_rows(frames, ids, mode="interpret")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_gather_repeated_and_boundary_ids():
+    frames = jnp.arange(8 * 384, dtype=jnp.uint8).reshape(8, 384)
+    ids = jnp.asarray([0, 7, 7, 3, 0, 0, 7, 1, 2], jnp.int32)
+    got = gather_rows(frames, ids, mode="interpret")
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(frames)[np.asarray(ids)])
+
+
+def test_frame_pool_sample_parity_with_pallas_gather():
+    """Same replay state + key: sampling through the pallas kernel
+    (interpret) returns the exact batch of the XLA gather path."""
+    import dataclasses
+
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+
+    spec_x = FramePoolReplay(capacity=64, frame_shape=(8, 8, 1),
+                             frame_stack=3, gather_mode="xla")
+    spec_p = dataclasses.replace(spec_x, gather_mode="interpret")
+    state = spec_x.init()
+    kf, k = 12, 8
+    rng = np.random.default_rng(7)
+    for c in range(4):
+        chunk = dict(
+            frames=rng.integers(0, 255, (kf, 64), np.uint8),
+            n_frames=np.int32(kf), n_trans=np.int32(k),
+            action=rng.integers(0, 4, k).astype(np.int32),
+            reward=rng.normal(size=k).astype(np.float32),
+            discount=np.full(k, 0.97, np.float32),
+            obs_ref=np.sort(rng.integers(0, kf, (k, 3)), axis=1)
+                      .astype(np.int32),
+            next_ref=np.sort(rng.integers(0, kf, (k, 3)), axis=1)
+                       .astype(np.int32),
+        )
+        chunk = {kk: jnp.asarray(v) for kk, v in chunk.items()}
+        state = spec_x.add(state, chunk,
+                           jnp.abs(jax.random.normal(jax.random.key(c),
+                                                     (k,))) + 0.1)
+    key = jax.random.key(42)
+    bx, wx, ix = spec_x.sample(state, key, 16, 0.5)
+    bp, wp, ip = spec_p.sample(state, key, 16, 0.5)
+    np.testing.assert_array_equal(np.asarray(ix), np.asarray(ip))
+    np.testing.assert_array_equal(np.asarray(bx["obs"]), np.asarray(bp["obs"]))
+    np.testing.assert_array_equal(np.asarray(bx["next_obs"]),
+                                  np.asarray(bp["next_obs"]))
+    np.testing.assert_allclose(np.asarray(wx), np.asarray(wp))
+
+
+def test_row_padding_and_eligibility():
+    """Pixel rings pad rows to whole (8,128) tiles for the kernel; small
+    vector rings stay unpadded and auto-route to XLA; the kernel itself
+    refuses layouts it cannot slice."""
+    from apex_tpu.ops.gather import ROW_UNIT, pallas_eligible
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+
+    atari = FramePoolReplay(capacity=64, frame_shape=(84, 84, 1))
+    assert atari.row_dim == 7168 and atari.row_dim % ROW_UNIT == 0
+    catch = FramePoolReplay(capacity=64, frame_shape=(42, 42, 1))
+    assert catch.row_dim == 2048
+    cart = FramePoolReplay(capacity=64, frame_shape=(4,),
+                           frame_stack=1, frame_dtype="float32")
+    assert cart.row_dim == 4                 # unpadded -> XLA path
+    assert not pallas_eligible(4, jnp.float32)
+    assert pallas_eligible(7168, jnp.uint8)
+
+    with pytest.raises(ValueError, match="row dim"):
+        gather_rows(jnp.zeros((8, 36), jnp.uint8),
+                    jnp.zeros(4, jnp.int32), mode="interpret")
+
+
+def test_padded_ring_roundtrips_through_sample():
+    """A padded ring (42x42 -> 2048-wide rows) must store and return the
+    exact unpadded frames through add + sample."""
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+
+    spec = FramePoolReplay(capacity=32, frame_shape=(42, 42, 1),
+                           frame_stack=2)
+    assert spec.row_dim == 2048
+    state = spec.init()
+    rng = np.random.default_rng(3)
+    kf, k = 6, 4
+    chunk = dict(
+        frames=rng.integers(0, 255, (kf, 1764), np.uint8),
+        n_frames=np.int32(kf), n_trans=np.int32(k),
+        action=np.zeros(k, np.int32), reward=np.zeros(k, np.float32),
+        discount=np.ones(k, np.float32),
+        obs_ref=np.stack([np.arange(k), np.arange(k) + 1], 1).astype(np.int32),
+        next_ref=np.stack([np.arange(k) + 1, np.arange(k) + 2], 1)
+                   .astype(np.int32),
+    )
+    state = spec.add(state, {kk: jnp.asarray(v) for kk, v in chunk.items()},
+                     jnp.ones(k))
+    batch, _, idx = spec.sample(state, jax.random.key(0), 8, 0.4)
+    assert batch["obs"].shape == (8, 42, 42, 2)
+    i = int(idx[0])
+    got = np.asarray(batch["obs"][0])
+    want = np.stack([chunk["frames"][i].reshape(42, 42),
+                     chunk["frames"][i + 1].reshape(42, 42)], -1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_auto_mode_uses_xla_off_tpu():
+    """On the CPU CI platform auto must route to jnp.take (the kernel is
+    TPU-only); the call must still be correct under jit."""
+    frames = jnp.arange(16 * 128, dtype=jnp.float32).reshape(16, 128)
+    ids = jnp.asarray([5, 1, 14], jnp.int32)
+
+    @jax.jit
+    def f(fr, i):
+        return gather_rows(fr, i)
+
+    np.testing.assert_array_equal(np.asarray(f(frames, ids)),
+                                  np.asarray(frames)[np.asarray(ids)])

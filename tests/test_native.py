@@ -1,0 +1,164 @@
+"""Native shared-memory ring: semantics, cross-process transport, pool
+integration.  Skips wholesale when the image can't build the C++ side (the
+runtime then falls back to mp.Queue — exercised by every other test)."""
+
+import multiprocessing as mp
+import pickle
+import queue as queue_lib
+
+import numpy as np
+import pytest
+
+from apex_tpu.native import shm_available
+
+pytestmark = pytest.mark.skipif(not shm_available(),
+                                reason="native shm ring unavailable")
+
+
+def _ring(name, slot_size=4096, n_slots=4):
+    from apex_tpu.native.ring import ShmRing
+    return ShmRing(name, slot_size=slot_size, n_slots=n_slots, create=True)
+
+
+def test_ring_fifo_roundtrip():
+    r = _ring("/apexshm-test-fifo")
+    try:
+        msgs = [bytes([i]) * (i + 1) for i in range(10)]
+        for i, m in enumerate(msgs[:4]):
+            assert r.push(m, timeout_ms=100)
+        assert r.pending() == 4
+        out = [r.pop(timeout_ms=100) for _ in range(4)]
+        assert out == msgs[:4]
+        # interleaved
+        for m in msgs[4:]:
+            assert r.push(m, timeout_ms=100)
+            assert r.pop(timeout_ms=100) == m
+        assert r.pending() == 0
+        assert r.pop(timeout_ms=1) is None           # empty -> timeout
+    finally:
+        r.close()
+
+
+def test_ring_full_timeout_then_drain():
+    r = _ring("/apexshm-test-full", slot_size=256, n_slots=2)
+    try:
+        assert r.push(b"a", timeout_ms=50)
+        assert r.push(b"b", timeout_ms=50)
+        assert not r.push(b"c", timeout_ms=50)       # full: clean timeout
+        assert r.push_timeouts() == 1
+        assert r.pop(timeout_ms=50) == b"a"
+        assert r.push(b"c", timeout_ms=50)           # freed slot reusable
+        assert r.pop(timeout_ms=50) == b"b"
+        assert r.pop(timeout_ms=50) == b"c"
+    finally:
+        r.close()
+
+
+def test_ring_rejects_oversized_payload():
+    from apex_tpu.native.ring import ShmRingError
+    r = _ring("/apexshm-test-big", slot_size=64, n_slots=2)
+    try:
+        with pytest.raises(ShmRingError, match="slot size"):
+            r.push(b"x" * 64, timeout_ms=10)         # 64 + 8 prefix > 64
+    finally:
+        r.close()
+
+
+def _producer(name: str, worker: int, n_msgs: int) -> None:
+    from apex_tpu.native.ring import ShmRing
+    r = ShmRing(name)                                # open, not create
+    for i in range(n_msgs):
+        payload = pickle.dumps((worker, i, np.full(128, worker * 1000 + i)))
+        while not r.push(payload, timeout_ms=200):
+            pass
+    r.close()
+
+
+def test_ring_many_producers_one_consumer():
+    """3 producer processes, one consuming parent: every message arrives
+    exactly once, per-producer order preserved (MPSC contract)."""
+    name = "/apexshm-test-mpsc"
+    r = _ring(name, slot_size=8192, n_slots=8)
+    try:
+        ctx = mp.get_context("spawn")
+        n_msgs = 40
+        procs = [ctx.Process(target=_producer, args=(name, w, n_msgs),
+                             daemon=True) for w in range(3)]
+        for p in procs:
+            p.start()
+        seen = {w: [] for w in range(3)}
+        for _ in range(3 * n_msgs):
+            got = r.pop(timeout_ms=10_000)
+            assert got is not None, "consumer starved"
+            w, i, arr = pickle.loads(got)
+            assert (arr == w * 1000 + i).all()
+            seen[w].append(i)
+        for p in procs:
+            p.join(timeout=10)
+        assert all(seen[w] == list(range(n_msgs)) for w in range(3))
+        assert r.pop(timeout_ms=10) is None
+    finally:
+        r.close()
+
+
+def test_chunk_queue_facade():
+    """The mp.Queue-shaped surface ActorPool drives: put/get/get_nowait,
+    Empty on empty, pickle-through of chunk-message dicts."""
+    from apex_tpu.native.ring import ShmChunkQueue
+    q = ShmChunkQueue("/apexshm-test-facade", slot_bytes=1 << 16, depth=4)
+    try:
+        msg = {"payload": {"frames": np.arange(100, dtype=np.uint8)},
+               "priorities": np.ones(3, np.float32), "n_trans": 3}
+        q.put(("chunk", 0, msg))
+        kind, actor_id, out = q.get(timeout=0.5)
+        assert (kind, actor_id) == ("chunk", 0)
+        np.testing.assert_array_equal(out["payload"]["frames"],
+                                      msg["payload"]["frames"])
+        with pytest.raises(queue_lib.Empty):
+            q.get_nowait()
+        with pytest.raises(queue_lib.Empty):
+            q.get(timeout=0.05)
+    finally:
+        q.close()
+
+
+def test_actor_pool_uses_shm_plane():
+    """ApexTrainer's pool rides the native ring end-to-end: chunks from real
+    worker processes cross shared memory, training proceeds, shutdown is
+    clean and the segment is unlinked."""
+    import os
+
+    from apex_tpu.config import small_test_config
+    from apex_tpu.native.ring import ShmChunkQueue
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = small_test_config(capacity=1024, batch_size=32, n_actors=2)
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05)
+    assert isinstance(trainer.pool.chunk_queue, ShmChunkQueue), \
+        "shm plane expected by default when shm_available()"
+    seg = "/dev/shm/" + trainer.pool.chunk_queue.name.lstrip("/")
+    trainer.train(total_steps=30, max_seconds=120)
+    assert trainer.steps_rate.total >= 30
+    assert trainer.ingested >= cfg.replay.warmup
+    assert all(not p.is_alive() for p in trainer.pool.procs)
+    assert not os.path.exists(seg), "segment must be unlinked on cleanup"
+
+
+def test_actor_pool_falls_back_without_shm():
+    """shm_data_plane=False (or an unavailable ring) must yield a plain
+    mp.Queue — the fleet still runs."""
+    import dataclasses
+
+    from apex_tpu.actors.pool import ActorPool
+    from apex_tpu.config import small_test_config
+
+    cfg = small_test_config(n_actors=1)
+    cfg = cfg.replace(actor=dataclasses.replace(cfg.actor,
+                                                shm_data_plane=False))
+    pool = ActorPool(cfg, {"num_actions": 2, "obs_is_image": False},
+                     chunk_transitions=16)
+    from apex_tpu.native.ring import ShmChunkQueue
+    assert not isinstance(pool.chunk_queue, ShmChunkQueue)
+    for q in [pool.chunk_queue, pool.stat_queue, *pool.param_queues]:
+        q.cancel_join_thread()
+        q.close()

@@ -72,15 +72,27 @@ def test_vector_family_contract():
 
 def test_vector_epsilons_span_global_ladder():
     """8 processes x 32 envs must reproduce the exploration spectrum of 256
-    scalar actors: worker i owns ladder slots [i*B, (i+1)*B)."""
-    ladder = actor_epsilons(256)
-    b = 32
-    for worker in (0, 3, 7):
-        slots = list(range(worker * b, (worker + 1) * b))
-        np.testing.assert_allclose(ladder[slots], ladder[worker * b:
-                                                         (worker + 1) * b])
-    # monotone decreasing across the whole fleet
-    assert (np.diff(ladder) < 0).all()
+    scalar actors: worker i owns ladder slots [i*B, (i+1)*B), with the
+    Ape-X formula eps_base^(1 + slot/(N-1) * eps_alpha) evaluated on the
+    GLOBAL slot index (batchrecorder.py:121), and the scalar workers'
+    per-slot seeds."""
+    from apex_tpu.actors.vector import worker_slots
+
+    cfg = small_test_config()
+    cfg = cfg.replace(actor=dataclasses.replace(
+        cfg.actor, n_actors=8, n_envs_per_actor=32))
+    all_slots, all_eps = [], []
+    for worker in range(8):
+        slot_ids, seeds, eps = worker_slots(cfg, worker)
+        assert slot_ids == list(range(worker * 32, (worker + 1) * 32))
+        assert seeds == [cfg.env.seed + 1000 * (s + 1) for s in slot_ids]
+        # independent formula, not the actor_epsilons implementation
+        want = [0.4 ** (1 + s / 255 * 7.0) for s in slot_ids]
+        np.testing.assert_allclose(eps, want, rtol=1e-12)
+        all_slots += slot_ids
+        all_eps += list(eps)
+    assert all_slots == list(range(256))
+    assert (np.diff(all_eps) < 0).all()   # monotone across the whole fleet
 
 
 def test_apex_trainer_with_vector_actors():
