@@ -220,15 +220,24 @@ class ActorPool:
             from apex_tpu.actors.vector import vector_worker_main
             worker_fn = vector_worker_main   # B envs/process, batched policy
         eps = actor_epsilons(n, cfg.actor.eps_base, cfg.actor.eps_alpha)
-        self.procs = [
-            ctx.Process(
-                target=worker_fn or _worker_main,
-                args=(i, cfg, model_spec, self.chunk_queue,
-                      self.param_queues[i], self.stat_queue, self.stop_event,
-                      float(eps[i]), chunk_transitions),
-                daemon=True)
+        self._ctx = ctx
+        self._worker_fn = worker_fn or _worker_main
+        self._worker_args = [
+            (i, cfg, model_spec, self.chunk_queue, self.param_queues[i],
+             self.stat_queue, self.stop_event, float(eps[i]),
+             chunk_transitions)
             for i in range(n)
         ]
+        self.procs = [ctx.Process(target=self._worker_fn, args=a,
+                                  daemon=True) for a in self._worker_args]
+        self._started = False
+        self._last_params: tuple | None = None
+        self.worker_deaths = 0          # cumulative respawn count
+        # a worker that keeps dying is a systemic failure (bad env, import
+        # error in the child), not flakiness: stop feeding the crash loop
+        # after this many respawns of one slot and run with a reduced fleet
+        self.max_respawns_per_slot = 5
+        self._slot_respawns = [0] * n
 
     @staticmethod
     def _make_chunk_queue(cfg: ApexConfig, depth: int,
@@ -253,12 +262,16 @@ class ActorPool:
 
     def start(self) -> None:
         """Spawn workers with a CPU-pinned JAX environment (module docstring)."""
+        self._spawn(self.procs)
+        self._started = True
+
+    def _spawn(self, procs) -> None:
         saved = {k: os.environ.get(k)
                  for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
         try:
-            for p in self.procs:
+            for p in procs:
                 p.start()
         finally:
             for k, v in saved.items():
@@ -266,6 +279,48 @@ class ActorPool:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+
+    # -- failure detection (beyond the reference: its fleets have no
+    # death-handling at all — an actor crash silently shrinks the fleet
+    # forever, SURVEY.md §5.3) ---------------------------------------------
+
+    def dead_workers(self) -> list[int]:
+        """Indices of workers that exited while the pool is live and are
+        still eligible for respawn (persistent crashers age out, see
+        ``max_respawns_per_slot``)."""
+        if not self._started or self.stop_event.is_set():
+            return []
+        return [i for i, p in enumerate(self.procs)
+                if not p.is_alive()
+                and self._slot_respawns[i] < self.max_respawns_per_slot]
+
+    def respawn_worker(self, i: int) -> bool:
+        """Replace a dead worker with a fresh process on the same slot
+        (same global actor id, epsilon, seed — the fleet's exploration
+        spectrum is restored, not shifted).  The newest published params
+        are re-queued so the newcomer doesn't idle until the next publish.
+        Returns False once the slot has exhausted its respawn budget — the
+        fleet then runs reduced, loudly."""
+        old = self.procs[i]
+        if old.is_alive():
+            return True
+        if self._slot_respawns[i] >= self.max_respawns_per_slot:
+            return False
+        old.join(timeout=0)            # reap the zombie
+        self.procs[i] = self._ctx.Process(target=self._worker_fn,
+                                          args=self._worker_args[i],
+                                          daemon=True)
+        self._spawn([self.procs[i]])
+        self.worker_deaths += 1
+        self._slot_respawns[i] += 1
+        if self._slot_respawns[i] >= self.max_respawns_per_slot:
+            print(f"apex_tpu: actor slot {i} died "
+                  f"{self._slot_respawns[i]}x; giving up on it — "
+                  f"running with a reduced fleet", flush=True)
+        if self._last_params is not None:
+            version, params = self._last_params
+            self._put_latest(self.param_queues[i], version, params)
+        return True
 
     def cleanup(self, grace_seconds: float = 10.0) -> None:
         """Stop workers (reference ``BatchRecorder.cleanup``,
@@ -301,16 +356,21 @@ class ActorPool:
     def publish_params(self, version: int, params: Any) -> None:
         """Latest-wins broadcast (reference ``set_worker_weights``,
         ``batchrecorder.py:140-146``, + PUB/CONFLATE semantics)."""
+        self._last_params = (version, params)
         for q in self.param_queues:
-            while True:  # drop the stalest entry if the depth-2 queue is full
+            self._put_latest(q, version, params)
+
+    @staticmethod
+    def _put_latest(q, version: int, params: Any) -> None:
+        while True:      # drop the stalest entry if the depth-2 queue is full
+            try:
+                q.put_nowait((version, params))
+                break
+            except queue_lib.Full:
                 try:
-                    q.put_nowait((version, params))
-                    break
-                except queue_lib.Full:
-                    try:
-                        q.get_nowait()
-                    except queue_lib.Empty:
-                        pass
+                    q.get_nowait()
+                except queue_lib.Empty:
+                    pass
 
     def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:
         """Drain up to ``max_chunks`` transition chunks."""

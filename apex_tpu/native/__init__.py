@@ -80,6 +80,10 @@ def _load() -> ctypes.CDLL | None:
                    "apex_shm_slot_size"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.apex_shm_force_skip.restype = ctypes.c_int
+        lib.apex_shm_force_skip.argtypes = [ctypes.c_void_p]
+        lib.apex_shm_test_claim.restype = None
+        lib.apex_shm_test_claim.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

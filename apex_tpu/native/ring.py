@@ -22,6 +22,7 @@ from __future__ import annotations
 import ctypes
 import pickle
 import queue as queue_lib
+import time
 
 from apex_tpu import native
 
@@ -58,8 +59,10 @@ class ShmRing:
     # -- raw ops -----------------------------------------------------------
 
     def push(self, data: bytes, timeout_ms: int = -1) -> bool:
-        """False on timeout (ring full).  Raises when ``data`` can never
-        fit a slot."""
+        """False when not delivered — ring full (timeout) or the ticket
+        was disposed by a consumer force-skip while this producer stalled
+        (rc -3); either way a retry re-sends under a fresh ticket.  Raises
+        when ``data`` can never fit a slot."""
         rc = self._lib.apex_shm_push(self._h, data, len(data), timeout_ms)
         if rc == -2:
             raise ShmRingError(
@@ -79,6 +82,12 @@ class ShmRing:
 
     def pending(self) -> int:
         return int(self._lib.apex_shm_pending(self._h))
+
+    def force_skip(self) -> bool:
+        """Plant a tombstone over a claimed-but-never-published head ticket
+        (producer died mid-write).  Call ONLY after a long starvation
+        window — see the C-side contract in shm_ring.cpp."""
+        return bool(self._lib.apex_shm_force_skip(self._h))
 
     def push_timeouts(self) -> int:
         """Cumulative push timeout returns — BACKPRESSURE events (a full
@@ -115,6 +124,12 @@ class ShmChunkQueue:
         cls._counter += 1
         return cls._counter
 
+    # a wedged head ticket (producer SIGKILLed inside its microsecond
+    # claim->publish window) is force-skipped after this much continuous
+    # starvation with pending messages — orders of magnitude beyond any
+    # live producer's memcpy
+    STUCK_SECONDS = 10.0
+
     def __init__(self, name: str, slot_bytes: int, depth: int):
         self.name = name
         self.slot_bytes = slot_bytes
@@ -122,6 +137,8 @@ class ShmChunkQueue:
         self._ring: ShmRing | None = ShmRing(
             name, slot_size=slot_bytes, n_slots=depth, create=True)
         self._owner = True
+        self._starved_since: float | None = None
+        self.skipped = 0                # force-skipped wedged tickets
 
     # -- pickling into workers --------------------------------------------
 
@@ -133,6 +150,8 @@ class ShmChunkQueue:
         self.__dict__.update(state)
         self._ring = None          # re-open lazily in the child
         self._owner = False
+        self._starved_since = None
+        self.skipped = 0
 
     def _open(self) -> ShmRing:
         if self._ring is None:
@@ -148,16 +167,39 @@ class ShmChunkQueue:
             pass                   # full: keep blocking, like mp.Queue.put
 
     def get(self, timeout: float = 0.0):
-        got = self._open().pop(timeout_ms=max(1, int(timeout * 1000)))
-        if got is None:
-            raise queue_lib.Empty
-        return pickle.loads(got)
+        return self._get(max(1, int(timeout * 1000)))
 
     def get_nowait(self):
-        got = self._open().pop(timeout_ms=0)
-        if got is None:
-            raise queue_lib.Empty
-        return pickle.loads(got)
+        return self._get(0)
+
+    def _get(self, timeout_ms: int):
+        ring = self._open()
+        got = ring.pop(timeout_ms=timeout_ms)
+        if got is not None:
+            self._starved_since = None
+            try:
+                return pickle.loads(got)
+            except Exception:
+                # a force-skipped producer's resurrected memcpy can corrupt
+                # one payload (shm_ring.cpp force-skip contract): count and
+                # drop it rather than crash the learner
+                self.skipped += 1
+                raise queue_lib.Empty
+        # starving: if messages are pending but nothing publishes for
+        # STUCK_SECONDS, the head ticket's producer died mid-write —
+        # tombstone it so the ring advances (shm_ring.cpp force-skip
+        # contract)
+        if ring.pending() > 0:
+            now = time.monotonic()
+            if self._starved_since is None:
+                self._starved_since = now
+            elif now - self._starved_since > self.STUCK_SECONDS:
+                if ring.force_skip():
+                    self.skipped += 1
+                self._starved_since = None
+        else:
+            self._starved_since = None
+        raise queue_lib.Empty
 
     def pending(self) -> int:
         return self._open().pending()

@@ -25,12 +25,15 @@
 // Waits spin briefly then sleep-poll (50us); chunk rates are O(10^2)
 // messages/s, so poll latency is irrelevant — copy count is what matters.
 //
-// Crash notes: a producer killed between CAS-claim and publish leaves one
-// slot permanently unpublished, wedging the consumer at that ticket — the
-// same class of loss as killing a process inside mp.Queue.put (corrupted
-// pipe).  ActorPool.cleanup drains with timeouts and destroys the segment,
-// so shutdown never depends on ring liveness.  The creator unlinks any
-// stale same-named segment left by a crashed run.
+// Crash notes: a producer killed between CAS-claim and publish (a
+// microsecond window) leaves one slot unpublished, starving the consumer
+// at that ticket — the same class of loss as killing a process inside
+// mp.Queue.put (corrupted pipe).  The consumer recovers via
+// apex_shm_force_skip after a long starvation window (see the function's
+// contract below; ShmChunkQueue applies it automatically).
+// ActorPool.cleanup drains with timeouts and destroys the segment, so
+// shutdown never depends on ring liveness.  The creator unlinks any stale
+// same-named segment left by a crashed run.
 //
 // Exposed as a plain-C ABI for ctypes (no pybind11 in this image).
 
@@ -163,7 +166,9 @@ void apex_shm_close(void* handle) {
 }
 
 // 0 = ok, -1 = timeout (ring full; nothing claimed), -2 = payload too
-// large for a slot.
+// large for a slot, -3 = ticket disposed by the consumer's force-skip
+// while this producer was stalled (message NOT delivered; caller may
+// simply push again under a fresh ticket).
 int apex_shm_push(void* handle, const uint8_t* data, uint64_t len,
                   int timeout_ms) {
   auto* r = (Ring*)handle;
@@ -196,7 +201,19 @@ int apex_shm_push(void* handle, const uint8_t* data, uint64_t len,
   uint8_t* slot = r->slots + s * h->slot_size;
   memcpy(slot, &len, 8);
   memcpy(slot + 8, data, len);
-  r->seq[s].v.store(t + 1, std::memory_order_release);
+  // Publish via CAS: if the consumer force-skipped this ticket while we
+  // were stalled between claim and here, seq has already moved on — we
+  // must NOT touch it (a blind store would deadlock the ring for every
+  // later ticket on this slot).  The memcpy above may then have raced the
+  // slot's next owner; the consumer tolerates that as one corrupt payload
+  // (unpickle failure -> skipped), and we report -3 so the caller resends.
+  uint64_t expect = t;
+  if (!r->seq[s].v.compare_exchange_strong(expect, t + 1,
+                                           std::memory_order_release,
+                                           std::memory_order_relaxed)) {
+    h->dropped.fetch_add(1, std::memory_order_relaxed);
+    return -3;
+  }
   return 0;
 }
 
@@ -226,6 +243,37 @@ int64_t apex_shm_pop(void* handle, uint8_t* out, uint64_t cap,
 
 uint64_t apex_shm_dropped(void* handle) {
   return ((Ring*)handle)->hdr->dropped.load(std::memory_order_relaxed);
+}
+
+// Consumer-side wedge recovery: if the head ticket was claimed (tail moved
+// past it) but never published — its producer died (or stalled
+// indefinitely) between CAS-claim and its publish — dispose of the ticket
+// and free the slot in ONE CAS (t -> t + n_slots), advancing head past it.
+// The CALLER supplies the liveness judgment (e.g. "pop has timed out for N
+// seconds while pending() > 0").  If the claimant later resurrects, its
+// own publish CAS fails cleanly (returns -3, see apex_shm_push); the only
+// residual risk is its in-flight memcpy racing the slot's next owner —
+// one corrupt payload, caught at unpickle, never a wedged ring.
+// Returns 1 if skipped, 0 if the head is published/unclaimed.
+int apex_shm_force_skip(void* handle) {
+  auto* r = (Ring*)handle;
+  Header* h = r->hdr;
+  uint64_t t = h->head;
+  if (h->tail.load(std::memory_order_relaxed) <= t) return 0;  // unclaimed
+  uint64_t s = t % h->n_slots;
+  uint64_t expect = t;  // claimed-but-unpublished state
+  if (!r->seq[s].v.compare_exchange_strong(expect, t + h->n_slots,
+                                           std::memory_order_release,
+                                           std::memory_order_relaxed))
+    return 0;           // published in the meantime: nothing to skip
+  h->head = t + 1;
+  return 1;
+}
+
+// TEST ONLY: claim the next ticket and never publish it — simulates a
+// producer killed mid-write so force_skip paths can be exercised.
+void apex_shm_test_claim(void* handle) {
+  ((Ring*)handle)->hdr->tail.fetch_add(1, std::memory_order_relaxed);
 }
 
 // Messages published-or-claimed and not yet consumed (approximate).

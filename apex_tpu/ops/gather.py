@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -126,8 +127,16 @@ def gather_rows(frames: jax.Array, ids: jax.Array,
     """
     d = math.prod(frames.shape[1:])
     if mode == "auto":
-        mode = ("pallas" if frames.ndim == 3 and _on_tpu(frames)
-                and pallas_eligible(d, frames.dtype) else "xla")
+        forced = os.environ.get("APEX_GATHER_MODE")  # operational override
+        if forced not in (None, "", "auto"):
+            if forced not in ("pallas", "interpret", "xla"):
+                raise ValueError(
+                    f"APEX_GATHER_MODE={forced!r}: expected pallas | "
+                    f"interpret | xla | auto")
+            mode = forced
+        else:
+            mode = ("pallas" if frames.ndim == 3 and _on_tpu(frames)
+                    and pallas_eligible(d, frames.dtype) else "xla")
     if mode in ("pallas", "interpret"):
         if d % 8:
             raise ValueError(

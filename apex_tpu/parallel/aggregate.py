@@ -46,6 +46,14 @@ class ChunkAggregator:
     def needs_warmup_republish(self):
         return getattr(self.pool, "needs_warmup_republish", False)
 
+    # failure detection passes through so sharded runs keep respawn-on-death
+    # (the trainer feature-detects via hasattr)
+
+    def __getattr__(self, name):
+        if name in ("dead_workers", "respawn_worker", "worker_deaths"):
+            return getattr(self.pool, name)
+        raise AttributeError(name)
+
     # -- aggregation --------------------------------------------------------
 
     def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:

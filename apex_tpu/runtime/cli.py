@@ -91,6 +91,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--beta", type=float, default=0.4)
     # misc
     p.add_argument("--logdir", default=e.get("APEX_LOGDIR"))
+    p.add_argument("--profile-dir", default=e.get("APEX_PROFILE_DIR"),
+                   help="capture a jax.profiler (XProf) trace of the "
+                        "learner run into this directory")
     p.add_argument("--checkpoint-dir", default=e.get("APEX_CKPT_DIR"))
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint path (enjoy role)")
@@ -140,10 +143,24 @@ def identity_from_args(args: argparse.Namespace) -> RoleIdentity:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import contextlib
+
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     identity = identity_from_args(args)
 
+    if args.profile_dir and args.role in ("learner", "apex", "dqn", "aql"):
+        from apex_tpu.utils.profiling import trace
+        profile_ctx = trace(args.profile_dir)
+    else:
+        profile_ctx = contextlib.nullcontext()
+
+    with profile_ctx:
+        return _dispatch(args, cfg, identity)
+
+
+def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
+              identity: RoleIdentity) -> int:
     if args.role == "learner":
         from apex_tpu.runtime.roles import run_learner
         run_learner(cfg, n_peers=args.n_actors + args.n_evaluators,

@@ -105,6 +105,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
             t_end = last_publish + max_seconds
             episode_idx = 0
             last_save = last_log = -1
+            last_health = last_publish
             metrics = None      # no update has run yet this call (a restored
                                 # trainer can hit the log gate before one)
 
@@ -184,6 +185,16 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     self._publish()
                     last_publish = now
 
+                # Failure detection (beyond the reference, SURVEY.md §5.3:
+                # its fleets never notice actor death): crashed workers are
+                # logged and respawned on the same ladder slot.
+                if (self.respawn_workers and now - last_health >= 5.0
+                        and hasattr(pool, "dead_workers")):
+                    for dead in pool.dead_workers():
+                        self.log.scalars({"worker_respawn": dead}, steps)
+                        pool.respawn_worker(dead)
+                    last_health = now
+
                 for stat in pool.poll_stats():
                     self.log.scalars(
                         {"episode_reward": stat.reward,
@@ -231,7 +242,7 @@ class ApexTrainer(ConcurrentTrainer):
                  train_ratio: float | None = None,
                  min_train_ratio: float | None = None,
                  checkpoint_dir: str | None = None,
-                 pool=None):
+                 pool=None, respawn_workers: bool = True):
         """Replay-ratio control (samples consumed per transition ingested):
 
         ``train_ratio`` caps the ratio — the learner idles when it has
@@ -250,6 +261,7 @@ class ApexTrainer(ConcurrentTrainer):
         self.publish_min_seconds = publish_min_seconds
         self.train_ratio = train_ratio
         self.min_train_ratio = min_train_ratio
+        self.respawn_workers = respawn_workers
         if (train_ratio is not None and min_train_ratio is not None
                 and min_train_ratio > train_ratio):
             raise ValueError("min_train_ratio must be <= train_ratio")

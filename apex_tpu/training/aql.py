@@ -420,6 +420,7 @@ class AQLApexTrainer(ConcurrentTrainer):
         self.publish_min_seconds = publish_min_seconds
         self.train_ratio = train_ratio
         self.min_train_ratio = min_train_ratio
+        self.respawn_workers = True
         if (train_ratio is not None and min_train_ratio is not None
                 and min_train_ratio > train_ratio):
             raise ValueError("min_train_ratio must be <= train_ratio")

@@ -134,13 +134,19 @@ def bench_end_to_end() -> dict:
     from apex_tpu.config import small_test_config
     from apex_tpu.training.apex import ApexTrainer
 
-    cfg = small_test_config(capacity=2 ** 14, batch_size=BATCH, n_actors=4)
+    n_actors, n_envs = 4, 8          # 32 ladder slots in 4 processes
+    cfg = small_test_config(capacity=2 ** 14, batch_size=BATCH,
+                            n_actors=n_actors)
     cfg = cfg.replace(
         learner=dataclasses.replace(cfg.learner, batch_size=BATCH,
                                     ingest_chunk=BATCH,
                                     compute_dtype="bfloat16"),
-        replay=dataclasses.replace(cfg.replay, warmup=2048))
+        replay=dataclasses.replace(cfg.replay, warmup=2048),
+        actor=dataclasses.replace(cfg.actor, n_envs_per_actor=n_envs))
     trainer = ApexTrainer(cfg, publish_min_seconds=0.5)
+    from apex_tpu.native.ring import ShmChunkQueue
+    data_plane = ("shm" if isinstance(trainer.pool.chunk_queue,
+                                      ShmChunkQueue) else "mp.Queue")
     t0 = time.monotonic()
     trainer.train(total_steps=10 ** 9, max_seconds=E2E_SECONDS,
                   log_every=10 ** 9)
@@ -153,12 +159,22 @@ def bench_end_to_end() -> dict:
                 round(trainer.steps_rate.rate * BATCH, 1),
             "total_frames": trainer.ingested,
             "total_steps": trainer.steps_rate.total,
-            "actors": cfg.actor.n_actors,
+            "actors": n_actors, "envs_per_actor": n_envs,
+            "data_plane": data_plane,
             "seconds": round(dt, 1)}
 
 
 def main() -> None:
-    fused = bench_fused_step()
+    # The fused step routes the frame gather through the pallas kernel on
+    # TPU (ops/gather.py).  If the kernel ever fails to compile on a new
+    # runtime, fall back to the XLA gather rather than losing the metric.
+    try:
+        fused = bench_fused_step()
+        fused["gather"] = os.environ.get("APEX_GATHER_MODE", "auto")
+    except Exception:
+        os.environ["APEX_GATHER_MODE"] = "xla"
+        fused = bench_fused_step()
+        fused["gather"] = "xla-fallback"
     try:
         e2e = bench_end_to_end()
     except Exception as exc:      # never lose the primary metric

@@ -1,0 +1,128 @@
+"""Failure detection: actor death is noticed and the slot respawned.
+
+The reference has NO death handling anywhere (SURVEY.md §5.3): a crashed
+actor silently shrinks the fleet for the rest of the run.  Here the pool
+reports dead workers and rebuilds them on the same ladder slot, and the
+concurrent trainer does this continuously during training.
+"""
+
+import time
+
+import numpy as np
+
+from apex_tpu.config import small_test_config
+from apex_tpu.training.apex import ApexTrainer, dqn_model_spec
+
+
+def test_pool_detects_and_respawns_dead_worker():
+    from apex_tpu.actors.pool import ActorPool
+
+    cfg = small_test_config(capacity=512, batch_size=16, n_actors=2)
+    pool = ActorPool(cfg, dqn_model_spec(cfg), chunk_transitions=16)
+    pool.start()
+    try:
+        assert pool.dead_workers() == []
+        pool.publish_params(1, _params(cfg))
+        deadline = time.monotonic() + 60
+        while not pool.poll_chunks(1) and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        victim = pool.procs[0]
+        victim.terminate()
+        victim.join(timeout=10)
+        deadline = time.monotonic() + 10
+        while pool.dead_workers() != [0] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.dead_workers() == [0]
+
+        pool.respawn_worker(0)
+        assert pool.worker_deaths == 1
+        assert pool.procs[0].is_alive()
+        assert pool.procs[0] is not victim
+        # the respawned slot produces data again (it got the re-queued
+        # params immediately, no need to wait for the next publish)
+        got = []
+        deadline = time.monotonic() + 60
+        while len(got) < 3 and time.monotonic() < deadline:
+            got += pool.poll_chunks(4, timeout=0.2)
+        assert len(got) >= 3, "fleet stopped producing after respawn"
+        assert pool.dead_workers() == []
+    finally:
+        pool.cleanup()
+
+
+def _crashing_worker(actor_id, cfg, model_spec, chunk_queue, param_queue,
+                     stat_queue, stop_event, epsilon, chunk_transitions):
+    raise RuntimeError("boom")      # deterministic startup crash
+
+
+def test_respawn_budget_stops_crash_loops():
+    """A worker that dies on every start exhausts its respawn budget and
+    drops out of dead_workers() — no infinite 5-second crash loop."""
+    from apex_tpu.actors.pool import ActorPool
+
+    cfg = small_test_config(n_actors=1)
+    pool = ActorPool(cfg, {"num_actions": 2, "obs_is_image": False},
+                     chunk_transitions=16, worker_fn=_crashing_worker)
+    pool.max_respawns_per_slot = 2
+    pool.start()
+    try:
+        respawns = 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            dead = pool.dead_workers()
+            if not dead and not pool.procs[0].is_alive():
+                break               # aged out of the respawn set
+            for i in dead:
+                assert pool.respawn_worker(i)
+                respawns += 1
+            time.sleep(0.1)
+        assert respawns == 2
+        assert pool.worker_deaths == 2
+        assert pool.dead_workers() == []          # budget exhausted
+        assert not pool.respawn_worker(0)         # and refuses directly
+    finally:
+        pool.cleanup(grace_seconds=1)
+
+
+def _params(cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.apex import dqn_env_specs
+    from apex_tpu.training.state import create_train_state
+
+    model_spec, frame_shape, frame_dtype, _ = dqn_env_specs(cfg)
+    ts = create_train_state(
+        DuelingDQN(**model_spec), make_optimizer(), jax.random.key(0),
+        np.zeros((1,) + frame_shape, frame_dtype))
+    return jax.device_get(ts.params)
+
+
+def test_trainer_survives_worker_death():
+    """Kill a worker mid-training: the trainer logs the respawn and the
+    run completes its step budget with a full fleet."""
+    import threading
+
+    cfg = small_test_config(capacity=1024, batch_size=32, n_actors=2)
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05)
+
+    def assassin():
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if trainer.ingested > 0 and trainer.pool.procs[1].is_alive():
+                trainer.pool.procs[1].terminate()
+                return
+            time.sleep(0.2)
+
+    killer = threading.Thread(target=assassin, daemon=True)
+    killer.start()
+    trainer.train(total_steps=60, max_seconds=240)
+    killer.join(timeout=1)
+
+    assert trainer.steps_rate.total >= 60
+    assert trainer.pool.worker_deaths >= 1, "death never detected"
+    assert trainer.log.history.get("learner/worker_respawn"), \
+        "respawn not logged"

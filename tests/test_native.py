@@ -5,6 +5,7 @@ runtime then falls back to mp.Queue — exercised by every other test)."""
 import multiprocessing as mp
 import pickle
 import queue as queue_lib
+import time
 
 import numpy as np
 import pytest
@@ -99,6 +100,54 @@ def test_ring_many_producers_one_consumer():
         assert r.pop(timeout_ms=10) is None
     finally:
         r.close()
+
+
+def test_force_skip_recovers_wedged_ring():
+    """A producer killed between claim and publish starves the consumer;
+    force_skip plants a tombstone so later (published) messages flow."""
+    from apex_tpu import native
+
+    r = _ring("/apexshm-test-wedge", slot_size=256, n_slots=4)
+    try:
+        lib = native._load()
+        lib.apex_shm_test_claim(r._h)        # dead producer: claim, no publish
+        assert r.push(b"real", timeout_ms=100)   # live producer on ticket 1
+        assert r.pop(timeout_ms=50) is None      # starved behind ticket 0
+        assert r.pending() == 2
+        assert r.force_skip()                    # dispose + free in one CAS
+        assert not r.force_skip()                # head now a published ticket
+        assert r.pop(timeout_ms=100) == b"real"  # data flows again
+        assert r.pending() == 0
+        # the freed slot is reusable by a later ticket
+        assert r.push(b"again", timeout_ms=100)
+        assert r.pop(timeout_ms=100) == b"again"
+    finally:
+        r.close()
+
+
+def test_chunk_queue_auto_recovers_from_dead_producer(monkeypatch):
+    """The facade applies the force-skip judgment itself: after
+    STUCK_SECONDS of starvation with pending messages, the wedged head is
+    skipped and queued messages deliver."""
+    from apex_tpu import native
+    from apex_tpu.native.ring import ShmChunkQueue
+
+    monkeypatch.setattr(ShmChunkQueue, "STUCK_SECONDS", 0.3)
+    q = ShmChunkQueue("/apexshm-test-autoskip", slot_bytes=4096, depth=4)
+    try:
+        native._load().apex_shm_test_claim(q._ring._h)   # wedge ticket 0
+        q.put(("chunk", 1, {"n_trans": 3}))
+        deadline = time.monotonic() + 10
+        got = None
+        while got is None and time.monotonic() < deadline:
+            try:
+                got = q.get(timeout=0.1)
+            except queue_lib.Empty:
+                pass
+        assert got == ("chunk", 1, {"n_trans": 3})
+        assert q.skipped == 1
+    finally:
+        q.close()
 
 
 def test_chunk_queue_facade():
