@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from apex_tpu.actors.vector import VectorFamilyBase
 from apex_tpu.config import ApexConfig
 
 
@@ -70,3 +71,80 @@ def aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
                              chunk_transitions=chunk_transitions)
     worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
                 stop_event, epsilon)
+
+
+class VectorAQLWorkerFamily(VectorFamilyBase):
+    """B-env AQL acting: one batched propose+score per step, per-slot
+    transition builders — the AQL counterpart of
+    :class:`apex_tpu.actors.vector.VectorDQNWorkerFamily`, sharing its
+    scaffolding through :class:`~apex_tpu.actors.vector.VectorFamilyBase`
+    and driven by the same family-agnostic ``vector_worker_loop``."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
+                 slot_ids, epsilons, chunk_transitions: int):
+        import jax
+
+        from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
+        from apex_tpu.training.aql import AQLTransitionBuilder
+
+        self._obs: list = []
+        super().__init__(cfg, seeds, slot_ids, epsilons)
+        self._obs = [None] * self.n_envs
+        self.policy = jax.jit(make_aql_policy_fn(AQLNetwork(**model_spec)))
+        self.builders = [AQLTransitionBuilder(cfg.learner.gamma)
+                         for _ in range(self.n_envs)]
+        self.chunk_transitions = chunk_transitions
+
+    def _make_env(self, seed: int):
+        from apex_tpu.envs.registry import make_env
+        return make_env(self.cfg.env.env_id, self.cfg.env, seed=seed,
+                        max_episode_steps=self.cfg.actor.max_episode_length)
+
+    def _on_reset(self, i: int, obs) -> None:
+        self._obs[i] = np.asarray(obs)
+
+    def step_all(self, params, key) -> list:
+        import jax.numpy as jnp
+
+        obs_batch = np.stack(self._obs)
+        actions, idx, a_mu, q = self.policy(
+            params, obs_batch, jnp.asarray(self._current_eps()), key)
+        actions, idx = np.asarray(actions), np.asarray(idx)
+        a_mu, q = np.asarray(a_mu), np.asarray(q)
+
+        stats: list = []
+        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
+            next_obs, reward, term, trunc, _ = env.step(actions[i])
+            builder.add_step(self._obs[i], int(idx[i]), float(reward),
+                             np.asarray(next_obs), a_mu[i], q[i],
+                             bool(term), bool(trunc))
+            self._obs[i] = np.asarray(next_obs)
+            self._finish_step(i, float(reward), bool(term or trunc), stats)
+        return stats
+
+    def poll_msgs(self) -> list[dict]:
+        out = []
+        for builder in self.builders:
+            while len(builder) >= self.chunk_transitions:
+                batch, prios = builder.drain(self.chunk_transitions)
+                out.append({"payload": batch, "priorities": prios,
+                            "n_trans": len(prios)})
+        return out
+
+
+def vector_aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                           chunk_queue, param_queue, stat_queue, stop_event,
+                           epsilon: float, chunk_transitions: int) -> None:
+    """Vector AQL process body (``epsilon`` ignored — slots re-derive
+    theirs from the global ladder, like the DQN vector body)."""
+    from apex_tpu.actors.vector import vector_worker_loop, worker_slots
+
+    slot_ids, seeds, epsilons = worker_slots(cfg, actor_id)
+    family = VectorAQLWorkerFamily(
+        cfg, model_spec, seeds=seeds, slot_ids=slot_ids, epsilons=epsilons,
+        chunk_transitions=chunk_transitions)
+    vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
+                       stat_queue, stop_event)
+
+
+vector_aql_worker_main.is_vector = True  # ActorPool guard marker

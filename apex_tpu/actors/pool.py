@@ -209,16 +209,18 @@ class ActorPool:
         self.param_queues = [ctx.Queue(maxsize=2) for _ in range(n)]
         self.stop_event = ctx.Event()
         if cfg.actor.n_envs_per_actor > 1:
-            if worker_fn is not None:
-                # only the DQN family has a vector body; silently falling
-                # back to one env/process would run a 1/B-rate fleet with
-                # the wrong exploration spectrum
+            if worker_fn is not None and not getattr(worker_fn, "is_vector",
+                                                     False):
+                # silently falling back to one env/process would run a
+                # 1/B-rate fleet with the wrong exploration spectrum
                 raise ValueError(
-                    "n_envs_per_actor > 1 requires the vectorized DQN "
-                    "worker; this pool was built with a custom worker_fn "
-                    f"({getattr(worker_fn, '__name__', worker_fn)})")
-            from apex_tpu.actors.vector import vector_worker_main
-            worker_fn = vector_worker_main   # B envs/process, batched policy
+                    "n_envs_per_actor > 1 requires a vectorized worker "
+                    "body (vector_worker_main / vector_aql_worker_main); "
+                    "this pool was built with "
+                    f"{getattr(worker_fn, '__name__', worker_fn)}")
+            if worker_fn is None:
+                from apex_tpu.actors.vector import vector_worker_main
+                worker_fn = vector_worker_main  # B envs, batched policy
         eps = actor_epsilons(n, cfg.actor.eps_base, cfg.actor.eps_alpha)
         self._ctx = ctx
         self._worker_fn = worker_fn or _worker_main

@@ -42,7 +42,69 @@ from apex_tpu.config import ApexConfig
 from apex_tpu.actors.pool import EpisodeStat
 
 
-class VectorDQNWorkerFamily:
+class VectorFamilyBase:
+    """Shared scaffolding for B-env worker families: slot bookkeeping, the
+    per-slot epsilon anneal, and episode accounting with auto-reset.  One
+    implementation for every family — the reference maintains near-copy
+    recorders per algorithm (``batchrecorder.py`` vs
+    ``batchrecoder_AQL.py``), the defect this hierarchy exists to avoid.
+
+    Subclasses provide ``_make_env(seed)``, ``_on_reset(i, obs)`` and
+    ``step_all``; the latter calls :meth:`_finish_step` per slot to get
+    uniform accounting/reset behavior.
+    """
+
+    def __init__(self, cfg: ApexConfig, seeds, slot_ids, epsilons):
+        self.cfg = cfg
+        self.seeds = list(seeds)
+        self.slot_ids = list(slot_ids)
+        self.epsilons = np.asarray(epsilons, np.float32)
+        self.n_envs = len(self.seeds)
+        assert self.n_envs == len(self.slot_ids) == len(self.epsilons)
+        self.envs = [self._make_env(s) for s in self.seeds]
+        self.ep_reward = np.zeros(self.n_envs, np.float64)
+        self.ep_len = np.zeros(self.n_envs, np.int64)
+        self.slot_steps = np.zeros(self.n_envs, np.int64)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_all(self) -> None:
+        for i, (env, seed) in enumerate(zip(self.envs, self.seeds)):
+            obs, _ = env.reset(seed=seed)
+            self._on_reset(i, obs)
+
+    def close(self) -> None:
+        for env in self.envs:
+            env.close()
+
+    # -- shared stepping helpers -------------------------------------------
+
+    def _current_eps(self) -> np.ndarray:
+        anneal = self.cfg.actor.eps_anneal_steps
+        if not anneal:
+            return self.epsilons
+        decay = np.exp(-self.slot_steps / anneal)
+        return (self.epsilons + (1.0 - self.epsilons) * decay).astype(
+            np.float32)
+
+    def _finish_step(self, i: int, reward: float, done: bool,
+                     stats: list) -> None:
+        """Per-slot accounting + auto-reset; appends an EpisodeStat with
+        the GLOBAL slot id when the episode ended."""
+        self.ep_reward[i] += reward
+        self.ep_len[i] += 1
+        self.slot_steps[i] += 1
+        if done:
+            stats.append(EpisodeStat(self.slot_ids[i],
+                                     float(self.ep_reward[i]),
+                                     int(self.ep_len[i])))
+            self.ep_reward[i] = 0.0
+            self.ep_len[i] = 0
+            obs, _ = self.envs[i].reset()
+            self._on_reset(i, obs)
+
+
+class VectorDQNWorkerFamily(VectorFamilyBase):
     """B-env DQN acting/recording: the vector counterpart of
     :class:`apex_tpu.actors.pool.DQNWorkerFamily`."""
 
@@ -50,23 +112,11 @@ class VectorDQNWorkerFamily:
                  slot_ids, epsilons, chunk_transitions: int):
         import jax
 
-        from apex_tpu.envs.registry import make_env, unstacked_env_spec
+        from apex_tpu.envs.registry import unstacked_env_spec
         from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
         from apex_tpu.replay.frame_chunks import FrameChunkBuilder
 
-        self.cfg = cfg
-        self.seeds = list(seeds)
-        self.slot_ids = list(slot_ids)
-        self.epsilons = np.asarray(epsilons, np.float32)
-        self.n_envs = len(self.seeds)
-        assert self.n_envs == len(self.slot_ids) == len(self.epsilons)
-
-        self.envs = [
-            make_env(cfg.env.env_id, cfg.env, seed=s,
-                     max_episode_steps=cfg.actor.max_episode_length,
-                     stack_frames=False)
-            for s in self.seeds
-        ]
+        super().__init__(cfg, seeds, slot_ids, epsilons)
         frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
             self.envs[0], cfg.env)
         self.policy = jax.jit(make_policy_fn(DuelingDQN(**model_spec)))
@@ -78,31 +128,14 @@ class VectorDQNWorkerFamily:
             for _ in range(self.n_envs)
         ]
 
-        # per-slot episode accounting
-        self.ep_reward = np.zeros(self.n_envs, np.float64)
-        self.ep_len = np.zeros(self.n_envs, np.int64)
-        self.slot_steps = np.zeros(self.n_envs, np.int64)
+    def _make_env(self, seed: int):
+        from apex_tpu.envs.registry import make_env
+        return make_env(self.cfg.env.env_id, self.cfg.env, seed=seed,
+                        max_episode_steps=self.cfg.actor.max_episode_length,
+                        stack_frames=False)
 
-    # -- lifecycle ---------------------------------------------------------
-
-    def reset_all(self) -> None:
-        for env, builder, seed in zip(self.envs, self.builders, self.seeds):
-            obs, _ = env.reset(seed=seed)
-            builder.begin_episode(obs)
-
-    def close(self) -> None:
-        for env in self.envs:
-            env.close()
-
-    # -- stepping ----------------------------------------------------------
-
-    def _current_eps(self) -> np.ndarray:
-        anneal = self.cfg.actor.eps_anneal_steps
-        if not anneal:
-            return self.epsilons
-        decay = np.exp(-self.slot_steps / anneal)
-        return (self.epsilons + (1.0 - self.epsilons) * decay).astype(
-            np.float32)
+    def _on_reset(self, i: int, obs) -> None:
+        self.builders[i].begin_episode(obs)
 
     def step_all(self, params, key) -> list[EpisodeStat]:
         """One batched policy call, then one env.step per slot.  Returns
@@ -121,17 +154,7 @@ class VectorDQNWorkerFamily:
             next_obs, reward, term, trunc, _ = env.step(a)
             builder.add_step(a, float(reward), q[i], next_obs,
                              bool(term), bool(trunc))
-            self.ep_reward[i] += float(reward)
-            self.ep_len[i] += 1
-            self.slot_steps[i] += 1
-            if term or trunc:
-                stats.append(EpisodeStat(self.slot_ids[i],
-                                         float(self.ep_reward[i]),
-                                         int(self.ep_len[i])))
-                self.ep_reward[i] = 0.0
-                self.ep_len[i] = 0
-                obs, _ = env.reset()
-                builder.begin_episode(obs)
+            self._finish_step(i, float(reward), bool(term or trunc), stats)
         return stats
 
     def poll_msgs(self) -> list[dict]:
@@ -224,3 +247,6 @@ def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
         chunk_transitions=chunk_transitions)
     vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
                        stat_queue, stop_event)
+
+
+vector_worker_main.is_vector = True     # ActorPool guard marker

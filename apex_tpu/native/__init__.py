@@ -76,8 +76,8 @@ def _load() -> ctypes.CDLL | None:
         lib.apex_shm_pop.restype = ctypes.c_int64
         lib.apex_shm_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_uint64, ctypes.c_int]
-        for fn in ("apex_shm_dropped", "apex_shm_pending",
-                   "apex_shm_slot_size"):
+        for fn in ("apex_shm_dropped", "apex_shm_disposed",
+                   "apex_shm_pending", "apex_shm_slot_size"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
         lib.apex_shm_force_skip.restype = ctypes.c_int

@@ -95,6 +95,12 @@ class ShmRing:
         callers retry and nothing is dropped."""
         return int(self._lib.apex_shm_dropped(self._h))
 
+    def disposed(self) -> int:
+        """Tickets force-skipped away from stalled producers — each was
+        one undelivered message (the producer's push returned -3 and, in
+        the facade, was resent under a fresh ticket)."""
+        return int(self._lib.apex_shm_disposed(self._h))
+
     def close(self) -> None:
         if self._h:
             self._lib.apex_shm_close(self._h)

@@ -60,6 +60,8 @@ struct Header {
   alignas(64) uint64_t head;               // consumer cursor (one consumer)
   alignas(64) std::atomic<uint64_t> dropped;  // push timeout returns
   // (backpressure events for blocking callers, NOT lost messages)
+  alignas(64) std::atomic<uint64_t> disposed;  // tickets force-skipped away
+  // from stalled producers (each is one undelivered message, resendable)
 };
 
 struct Seq {   // one per slot, padded: adjacent slots' producers don't
@@ -127,6 +129,7 @@ Ring* map_ring(const char* name, int create, uint64_t slot_size,
     hdr->tail.store(0, std::memory_order_relaxed);
     hdr->head = 0;
     hdr->dropped.store(0, std::memory_order_relaxed);
+    hdr->disposed.store(0, std::memory_order_relaxed);
     for (uint64_t i = 0; i < n_slots; ++i)
       seq[i].v.store(i, std::memory_order_relaxed);
   } else if (hdr->magic != kMagic) {
@@ -211,7 +214,7 @@ int apex_shm_push(void* handle, const uint8_t* data, uint64_t len,
   if (!r->seq[s].v.compare_exchange_strong(expect, t + 1,
                                            std::memory_order_release,
                                            std::memory_order_relaxed)) {
-    h->dropped.fetch_add(1, std::memory_order_relaxed);
+    h->disposed.fetch_add(1, std::memory_order_relaxed);
     return -3;
   }
   return 0;
@@ -243,6 +246,10 @@ int64_t apex_shm_pop(void* handle, uint8_t* out, uint64_t cap,
 
 uint64_t apex_shm_dropped(void* handle) {
   return ((Ring*)handle)->hdr->dropped.load(std::memory_order_relaxed);
+}
+
+uint64_t apex_shm_disposed(void* handle) {
+  return ((Ring*)handle)->hdr->disposed.load(std::memory_order_relaxed);
 }
 
 // Consumer-side wedge recovery: if the head ticket was claimed (tail moved

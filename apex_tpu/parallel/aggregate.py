@@ -50,7 +50,8 @@ class ChunkAggregator:
     # (the trainer feature-detects via hasattr)
 
     def __getattr__(self, name):
-        if name in ("dead_workers", "respawn_worker", "worker_deaths"):
+        if name in ("dead_workers", "respawn_worker", "worker_deaths",
+                    "silent_peers"):
             return getattr(self.pool, name)
         raise AttributeError(name)
 

@@ -154,15 +154,17 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
             cfg = cfg.replace(actor=dataclasses.replace(
                 cfg.actor, n_actors=identity.n_actors))
     elif family == "aql":
-        if cfg.actor.n_envs_per_actor > 1:
-            raise ValueError("n_envs_per_actor > 1 is DQN-only for now; "
-                             "the AQL family has no vector worker body")
         from apex_tpu.actors.aql import aql_worker_main
         from apex_tpu.envs.registry import make_env
         from apex_tpu.training.aql import aql_model_spec
         probe = make_env(cfg.env.env_id, cfg.env, seed=0)
         worker_fn, model_spec = aql_worker_main, aql_model_spec(cfg, probe)
         probe.close()
+        if cfg.actor.n_envs_per_actor > 1:
+            from apex_tpu.actors.aql import vector_aql_worker_main
+            worker_fn = vector_aql_worker_main
+            cfg = cfg.replace(actor=dataclasses.replace(
+                cfg.actor, n_actors=identity.n_actors))
     else:
         raise ValueError(f"unknown family {family!r}")
     try:

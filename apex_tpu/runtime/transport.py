@@ -153,6 +153,9 @@ class ChunkReceiver:
         self.sock.bind(f"tcp://{bind_ip}:{comms.batch_port}")
         self.chunks: queue_lib.Queue = queue_lib.Queue(maxsize=queue_depth)
         self.stats: queue_lib.Queue = queue_lib.Queue(maxsize=1024)
+        # liveness observability: last wall-clock a message arrived from
+        # each peer identity (actors AND evaluators — anything that sends)
+        self.last_seen: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -164,6 +167,7 @@ class ChunkReceiver:
             if not self.sock.poll(100, zmq.POLLIN):
                 continue
             ident, payload = self.sock.recv_multipart()
+            self.last_seen[ident.decode(errors="replace")] = time.monotonic()
             kind, body = pickle.loads(payload)
             if kind == "chunk":
                 # enqueue BEFORE acking: the ack is the credit grant
@@ -303,3 +307,13 @@ class RemotePool:
         except queue_lib.Empty:
             pass
         return out
+
+    def silent_peers(self, threshold_s: float = 30.0) -> list[str]:
+        """Peers that have checked in at least once but sent nothing for
+        ``threshold_s`` — a remote actor death shows up here (the learner
+        cannot respawn a remote process, but it can SAY so; the reference
+        topology loses actors silently forever, SURVEY.md §5.3)."""
+        now = time.monotonic()
+        # snapshot: the receiver thread inserts new peers concurrently
+        seen = list(self.receiver.last_seen.items())
+        return sorted(ident for ident, t in seen if now - t > threshold_s)

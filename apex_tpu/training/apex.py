@@ -188,11 +188,16 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # Failure detection (beyond the reference, SURVEY.md §5.3:
                 # its fleets never notice actor death): crashed workers are
                 # logged and respawned on the same ladder slot.
-                if (self.respawn_workers and now - last_health >= 5.0
-                        and hasattr(pool, "dead_workers")):
-                    for dead in pool.dead_workers():
-                        self.log.scalars({"worker_respawn": dead}, steps)
-                        pool.respawn_worker(dead)
+                if self.respawn_workers and now - last_health >= 5.0:
+                    if hasattr(pool, "dead_workers"):      # local fleets
+                        for dead in pool.dead_workers():
+                            self.log.scalars({"worker_respawn": dead}, steps)
+                            pool.respawn_worker(dead)
+                    if hasattr(pool, "silent_peers"):      # socket fleets
+                        silent = pool.silent_peers()
+                        if silent:
+                            self.log.scalars(
+                                {"silent_peers": len(silent)}, steps)
                     last_health = now
 
                 for stat in pool.poll_stats():

@@ -444,17 +444,22 @@ class AQLApexTrainer(ConcurrentTrainer):
         if pool is not None:
             self.pool = pool
         else:
-            # AQL chunks: K x (obs + next_obs + a_mu + scalars), far below
-            # the pixel default — size the ring slot from the actual spec
+            # AQL chunks: K x (obs + next_obs + a_mu candidate set +
+            # scalars) — size the ring slot from the actual spec
             k = cfg.actor.send_interval
             obs_bytes = (int(np.prod(obs_shape))
                          * np.dtype(obs_dtype).itemsize)
             act_dim = self.model_spec["action_dim"]
-            slot = k * (2 * obs_bytes + 4 * act_dim + 32) + 65536
+            t = (cfg.aql.propose_sample + cfg.aql.uniform_sample)
+            slot = k * (2 * obs_bytes + 4 * act_dim * (t + 1) + 32) + 65536
+            worker = aql_worker_main
+            if cfg.actor.n_envs_per_actor > 1:
+                from apex_tpu.actors.aql import vector_aql_worker_main
+                worker = vector_aql_worker_main
             self.pool = ActorPool(
                 cfg, self.model_spec,
                 chunk_transitions=cfg.actor.send_interval,
-                worker_fn=aql_worker_main, shm_slot_bytes=slot)
+                worker_fn=worker, shm_slot_bytes=slot)
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()

@@ -213,6 +213,27 @@ def test_aql_apex_pipeline_mechanics():
     assert np.isfinite(t.evaluate(episodes=1, max_steps=50))
 
 
+def test_aql_apex_vector_actors():
+    """Vectorized AQL actors: 1 process x 4 env slots act through ONE
+    batched propose+score call; slots carry global ladder ids; the
+    concurrent learner trains and shuts down clean."""
+    from apex_tpu.training.aql import AQLApexTrainer
+
+    cfg = small_test_config(capacity=2048, batch_size=32, n_actors=1,
+                            env_id="ApexContinuousNav-v0")
+    cfg = cfg.replace(
+        aql=dataclasses.replace(cfg.aql, propose_sample=8,
+                                uniform_sample=16),
+        actor=dataclasses.replace(cfg.actor, n_envs_per_actor=4))
+    t = AQLApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0)
+    t.train(total_steps=30, max_seconds=180)
+    assert t.steps_rate.total >= 30
+    assert t.ingested >= cfg.replay.warmup
+    slots = {int(v) for _, v in t.log.history.get("learner/actor_id", [])}
+    assert slots and max(slots) > 0, f"vector slots missing: {slots}"
+    assert all(not p.is_alive() for p in t.pool.procs)
+
+
 def test_aql_learns_continuous_nav():
     """AQL must beat random play on ContinuousNav: random returns ~-40,
     competent proposals reach > -20 within a small CI budget."""

@@ -153,6 +153,26 @@ def test_topology_sharded_learner_vector_actors():
             p.join(timeout=10)
 
 
+def test_remote_pool_reports_silent_peers():
+    """A remote actor that stops sending shows up in silent_peers after
+    the threshold (the learner can't respawn remote processes, but it no
+    longer loses them silently)."""
+    import time as time_mod
+
+    from apex_tpu.runtime.transport import RemotePool
+
+    cfg = _test_config(1)
+    pool = RemotePool(cfg.comms, n_peers=0, barrier_timeout_s=1)
+    try:
+        now = time_mod.monotonic()
+        pool.receiver.last_seen = {"actor-0": now - 100.0,
+                                   "actor-1": now - 1.0}
+        assert pool.silent_peers(threshold_s=30.0) == ["actor-0"]
+        assert pool.silent_peers(threshold_s=200.0) == []
+    finally:
+        pool.receiver.stop()
+
+
 def test_cli_parser_roles_and_env_twins(monkeypatch):
     from apex_tpu.runtime.cli import (build_parser, config_from_args,
                                       identity_from_args)
