@@ -87,9 +87,8 @@ class VectorAQLWorkerFamily(VectorFamilyBase):
         from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
         from apex_tpu.training.aql import AQLTransitionBuilder
 
-        self._obs: list = []
         super().__init__(cfg, seeds, slot_ids, epsilons)
-        self._obs = [None] * self.n_envs
+        self._obs: list = [None] * self.n_envs
         self.policy = jax.jit(make_aql_policy_fn(AQLNetwork(**model_spec)))
         self.builders = [AQLTransitionBuilder(cfg.learner.gamma)
                          for _ in range(self.n_envs)]

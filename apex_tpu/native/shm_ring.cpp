@@ -214,8 +214,7 @@ int apex_shm_push(void* handle, const uint8_t* data, uint64_t len,
   if (!r->seq[s].v.compare_exchange_strong(expect, t + 1,
                                            std::memory_order_release,
                                            std::memory_order_relaxed)) {
-    h->disposed.fetch_add(1, std::memory_order_relaxed);
-    return -3;
+    return -3;  // the skip itself was already counted in disposed
   }
   return 0;
 }
@@ -274,6 +273,7 @@ int apex_shm_force_skip(void* handle) {
                                            std::memory_order_relaxed))
     return 0;           // published in the meantime: nothing to skip
   h->head = t + 1;
+  h->disposed.fetch_add(1, std::memory_order_relaxed);
   return 1;
 }
 

@@ -153,9 +153,13 @@ class ChunkReceiver:
         self.sock.bind(f"tcp://{bind_ip}:{comms.batch_port}")
         self.chunks: queue_lib.Queue = queue_lib.Queue(maxsize=queue_depth)
         self.stats: queue_lib.Queue = queue_lib.Queue(maxsize=1024)
-        # liveness observability: last wall-clock a message arrived from
-        # each peer identity (actors AND evaluators — anything that sends)
+        # liveness observability: last wall-clock a message arrived per
+        # peer.  Membership = CHUNK senders only (actors): evaluators send
+        # one stat per episode — sometimes minutes apart — and finite-
+        # episode evaluators exit cleanly, both of which would be constant
+        # false alarms under a silence threshold.
         self.last_seen: dict[str, float] = {}
+        self._chunk_senders: set[str] = set()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -167,9 +171,11 @@ class ChunkReceiver:
             if not self.sock.poll(100, zmq.POLLIN):
                 continue
             ident, payload = self.sock.recv_multipart()
-            self.last_seen[ident.decode(errors="replace")] = time.monotonic()
+            name = ident.decode(errors="replace")
+            self.last_seen[name] = time.monotonic()
             kind, body = pickle.loads(payload)
             if kind == "chunk":
+                self._chunk_senders.add(name)
                 # enqueue BEFORE acking: the ack is the credit grant
                 while not self._stop.is_set():
                     try:
@@ -308,12 +314,16 @@ class RemotePool:
             pass
         return out
 
-    def silent_peers(self, threshold_s: float = 30.0) -> list[str]:
-        """Peers that have checked in at least once but sent nothing for
+    def silent_peers(self, threshold_s: float = 60.0) -> list[str]:
+        """CHUNK-sending peers (actors) that have sent nothing at all for
         ``threshold_s`` — a remote actor death shows up here (the learner
         cannot respawn a remote process, but it can SAY so; the reference
-        topology loses actors silently forever, SURVEY.md §5.3)."""
+        topology loses actors silently forever, SURVEY.md §5.3).  Sustained
+        credit-window backpressure can also trip this — the signal means
+        "look at this actor", not strictly "dead"."""
         now = time.monotonic()
-        # snapshot: the receiver thread inserts new peers concurrently
+        # snapshots: the receiver thread mutates both concurrently
+        senders = set(self.receiver._chunk_senders)
         seen = list(self.receiver.last_seen.items())
-        return sorted(ident for ident, t in seen if now - t > threshold_s)
+        return sorted(ident for ident, t in seen
+                      if ident in senders and now - t > threshold_s)

@@ -8,7 +8,7 @@ git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
 cd apex-tpu
 pip install -e . 'jax[tpu]' pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
 
-N_CHIPS=$(python -c 'import jax; print(len(jax.devices()))')
+# --mesh-dp defaults to 0 = all local chips; the runtime counts them itself
 tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
   --role learner --env-id ${env_id} --n-actors ${n_actors} \
   --batch-size 512 --train-ratio 16 --min-train-ratio 2 \

@@ -3,19 +3,21 @@
 # for replay/learner/actor/eval on 127.0.0.1).  Replay is dissolved into the
 # learner here, so the topology is learner + N actors + evaluator.
 #
-# Usage: scripts/run_local.sh [ENV_ID] [N_ACTORS] [TOTAL_STEPS]
+# Usage: scripts/run_local.sh [ENV_ID] [N_ACTORS] [TOTAL_STEPS] [ENVS_PER_ACTOR]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ENV_ID="${1:-ApexCartPole-v0}"
 N_ACTORS="${2:-2}"
 TOTAL_STEPS="${3:-2000}"
+ENVS_PER_ACTOR="${4:-1}"
 
 # CPU platform for every role: actors/evaluator must never dial the
 # single-client TPU tunnel; drop the env vars on the learner line to put its
 # fused step on the chip.
 export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
+        --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500
         --barrier-timeout 600)
 

@@ -47,6 +47,26 @@ def test_trainer_rejects_replay_over_hbm_budget():
         ApexTrainer(cfg)
 
 
+def test_apex_mechanics_atari_shapes():
+    """The FLAGSHIP shapes end to end: 84x84x1 uint8 frames, stack 4 —
+    the exact Nature-DQN geometry bench.py and the Pong target use.  This
+    exercises the tile-padded frame ring (7056 -> 7168 rows), the conv
+    trunk, and chunked actor ingest at real frame sizes; a few training
+    steps prove shape plumbing, not learning."""
+    import dataclasses
+
+    cfg = small_test_config(capacity=512, batch_size=16, n_actors=2,
+                            env_id="ApexCatch-v0")
+    cfg = cfg.replace(env=dataclasses.replace(cfg.env, frame_stack=4))
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05)
+    assert trainer.replay.row_dim == 7168          # padded for the kernel
+    assert trainer.replay.ring_shape == (1024, 8, 896)
+    trainer.train(total_steps=10, max_seconds=300)
+    assert trainer.steps_rate.total >= 10
+    assert trainer.ingested >= cfg.replay.warmup
+    assert all(not p.is_alive() for p in trainer.pool.procs)
+
+
 def test_apex_learns_catch(tmp_path):
     """The PIXEL path must learn end-to-end: conv trunk, device-side frame
     stacking from the frame-pool ring, chunked actor ingest.  CatchSmall

@@ -103,3 +103,39 @@ def test_checkpointer_prunes_to_keep(tmp_path):
 def test_config_meta_roundtrip():
     cfg = small_test_config(capacity=512, batch_size=64, n_actors=4)
     assert config_from_meta(config_to_meta(cfg)) == cfg
+
+
+def test_sharded_trainer_checkpoint_roundtrip(tmp_path):
+    """dp=8: the full bundle (replicated train state + 8 sharded frame-pool
+    replicas) saves, restores into a FRESH trainer, and the restored state
+    drives the sharded fused step — multi-chip runs are resumable too."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = small_test_config(capacity=512, batch_size=16, n_actors=2)
+    cfg = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, mesh_shape=(8,)))
+    t = ApexTrainer(cfg, publish_min_seconds=0.05,
+                    checkpoint_dir=str(tmp_path))
+    t.train(total_steps=12, max_seconds=240)
+    path = t.save_checkpoint()
+    saved_params = jax.device_get(t.train_state.params)
+    saved_steps = t.steps_rate.total
+
+    t2 = ApexTrainer(cfg, publish_min_seconds=0.05,
+                     checkpoint_dir=str(tmp_path))
+    t2.restore(path)
+    assert t2.steps_rate.total == saved_steps
+    restored = jax.device_get(t2.train_state.params)
+    for a, b in zip(jax.tree.leaves(saved_params),
+                    jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+
+    # the restored (host-resident) state must drive the SHARDED step
+    ts, rs, metrics = t2._train(t2.train_state, t2.replay_state,
+                                jax.random.key(7), jnp.float32(0.5))
+    assert np.isfinite(float(metrics["loss"]))

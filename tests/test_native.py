@@ -146,8 +146,46 @@ def test_chunk_queue_auto_recovers_from_dead_producer(monkeypatch):
                 pass
         assert got == ("chunk", 1, {"n_trans": 3})
         assert q.skipped == 1
+        assert q._ring.disposed() == 1     # the skip counted exactly once
     finally:
         q.close()
+
+
+def test_ring_random_sequences_match_fifo_model():
+    """Property test: arbitrary interleavings of push/pop against a deque
+    model — contents, order, pending count, and full/empty behavior all
+    agree (single-process; the MPSC test covers cross-process)."""
+    from collections import deque
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    ops = st.lists(st.one_of(
+        st.tuples(st.just("push"), st.binary(min_size=0, max_size=40)),
+        st.tuples(st.just("pop"), st.none()),
+    ), min_size=1, max_size=200)
+
+    @settings(max_examples=50, deadline=None)
+    @given(ops=ops)
+    def run(ops):
+        r = _ring("/apexshm-test-prop", slot_size=64, n_slots=4)
+        model: deque = deque()
+        try:
+            for op, arg in ops:
+                if op == "push":
+                    ok = r.push(arg, timeout_ms=0)
+                    assert ok == (len(model) < 4)
+                    if ok:
+                        model.append(arg)
+                else:
+                    got = r.pop(timeout_ms=0)
+                    want = model.popleft() if model else None
+                    assert got == want
+                assert r.pending() == len(model)
+        finally:
+            r.close()
+
+    run()
 
 
 def test_chunk_queue_facade():

@@ -166,7 +166,10 @@ def test_remote_pool_reports_silent_peers():
     try:
         now = time_mod.monotonic()
         pool.receiver.last_seen = {"actor-0": now - 100.0,
-                                   "actor-1": now - 1.0}
+                                   "actor-1": now - 1.0,
+                                   "evaluator-0": now - 500.0}
+        # only chunk senders count: the quiet evaluator is NOT a false alarm
+        pool.receiver._chunk_senders = {"actor-0", "actor-1"}
         assert pool.silent_peers(threshold_s=30.0) == ["actor-0"]
         assert pool.silent_peers(threshold_s=200.0) == []
     finally:
