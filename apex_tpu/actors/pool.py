@@ -236,10 +236,16 @@ class ActorPool:
         self._last_params: tuple | None = None
         self.worker_deaths = 0          # cumulative respawn count
         # a worker that keeps dying is a systemic failure (bad env, import
-        # error in the child), not flakiness: stop feeding the crash loop
-        # after this many respawns of one slot and run with a reduced fleet
+        # error in the child), not flakiness: respawns are RATE-LIMITED to
+        # this many per slot per window (anchored at the slot's last
+        # respawn).  Sporadic crashes over a long run never retire a
+        # healthy slot, and even a persistently-broken slot retries at a
+        # bounded rate — so a cause fixed mid-run (path restored, OOM
+        # relieved) recovers without intervention.
         self.max_respawns_per_slot = 5
+        self.respawn_window_s = 600.0
         self._slot_respawns = [0] * n
+        self._slot_last_respawn = [0.0] * n
 
     @staticmethod
     def _make_chunk_queue(cfg: ApexConfig, depth: int,
@@ -286,26 +292,40 @@ class ActorPool:
     # death-handling at all — an actor crash silently shrinks the fleet
     # forever, SURVEY.md §5.3) ---------------------------------------------
 
+    def _refresh_budget(self, i: int) -> None:
+        """A full window elapsed since the slot's LAST respawn restores its
+        budget (rate limit, not a lifetime cap — see __init__ comment)."""
+        if (self._slot_respawns[i]
+                and time.monotonic() - self._slot_last_respawn[i]
+                > self.respawn_window_s):
+            self._slot_respawns[i] = 0
+
     def dead_workers(self) -> list[int]:
         """Indices of workers that exited while the pool is live and are
-        still eligible for respawn (persistent crashers age out, see
-        ``max_respawns_per_slot``)."""
+        still eligible for respawn (RAPID crashers age out, see
+        ``max_respawns_per_slot`` / ``respawn_window_s``)."""
         if not self._started or self.stop_event.is_set():
             return []
-        return [i for i, p in enumerate(self.procs)
-                if not p.is_alive()
-                and self._slot_respawns[i] < self.max_respawns_per_slot]
+        out = []
+        for i, p in enumerate(self.procs):
+            if p.is_alive():
+                continue
+            self._refresh_budget(i)
+            if self._slot_respawns[i] < self.max_respawns_per_slot:
+                out.append(i)
+        return out
 
     def respawn_worker(self, i: int) -> bool:
         """Replace a dead worker with a fresh process on the same slot
         (same global actor id, epsilon, seed — the fleet's exploration
         spectrum is restored, not shifted).  The newest published params
         are re-queued so the newcomer doesn't idle until the next publish.
-        Returns False once the slot has exhausted its respawn budget — the
-        fleet then runs reduced, loudly."""
+        Returns False while the slot's rate budget is exhausted — the
+        fleet runs reduced, loudly, until the window rolls over."""
         old = self.procs[i]
         if old.is_alive():
             return True
+        self._refresh_budget(i)
         if self._slot_respawns[i] >= self.max_respawns_per_slot:
             return False
         old.join(timeout=0)            # reap the zombie
@@ -315,9 +335,11 @@ class ActorPool:
         self._spawn([self.procs[i]])
         self.worker_deaths += 1
         self._slot_respawns[i] += 1
+        self._slot_last_respawn[i] = time.monotonic()
         if self._slot_respawns[i] >= self.max_respawns_per_slot:
             print(f"apex_tpu: actor slot {i} died "
-                  f"{self._slot_respawns[i]}x; giving up on it — "
+                  f"{self._slot_respawns[i]}x within "
+                  f"{self.respawn_window_s:.0f}s; pausing its respawns — "
                   f"running with a reduced fleet", flush=True)
         if self._last_params is not None:
             version, params = self._last_params

@@ -81,6 +81,12 @@ def test_respawn_budget_stops_crash_loops():
         assert pool.worker_deaths == 2
         assert pool.dead_workers() == []          # budget exhausted
         assert not pool.respawn_worker(0)         # and refuses directly
+        # the budget is a RATE: surviving a full window restores it, so
+        # sporadic crashes over a long run never permanently retire a slot
+        pool.respawn_window_s = 0.05
+        time.sleep(0.1)
+        assert pool.dead_workers() == [0]
+        assert pool.respawn_worker(0)
     finally:
         pool.cleanup(grace_seconds=1)
 
