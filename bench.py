@@ -46,7 +46,10 @@ WARMUP_STEPS = 3
 # driver's real-chip run uses the defaults
 MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 50))
 REPS = int(os.environ.get("BENCH_REPS", 3))
-E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 60.0))
+# first TPU compile of the concurrent pipeline eats ~20-40s of this wall
+# budget; the steady-state window after it is what the sliding rate
+# counters report
+E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 90.0))
 
 
 def _synthetic_chunk(rng: np.random.Generator) -> tuple[dict, np.ndarray]:
