@@ -32,6 +32,11 @@ from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, EnvConfig,
                              LearnerConfig, ReplayConfig, RoleIdentity)
 
 
+def _env_bool(value: str) -> bool:
+    """Env-var booleans: '0'/'false'/'no'/'' are off (bool(str) is not)."""
+    return value.lower() not in ("", "0", "false", "no")
+
+
 def build_parser() -> argparse.ArgumentParser:
     e = os.environ
     ident = RoleIdentity.from_env(e)
@@ -97,6 +102,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=e.get("APEX_CKPT_DIR"))
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint path (enjoy role)")
+    p.add_argument("--restore", action=argparse.BooleanOptionalAction,
+                   default=_env_bool(e.get("APEX_RESTORE", "")),
+                   help="resume the learner from the newest checkpoint in "
+                        "--checkpoint-dir before training (bit-exact "
+                        "learner state; actors re-sync from the first "
+                        "post-restore publish); --no-restore overrides the "
+                        "APEX_RESTORE env var")
     p.add_argument("--episodes", type=int, default=0,
                    help="evaluator/enjoy episode budget (0 = forever)")
     p.add_argument("--verbose", action="store_true")
@@ -146,6 +158,8 @@ def main(argv: list[str] | None = None) -> int:
     import contextlib
 
     args = build_parser().parse_args(argv)
+    if args.restore and not args.checkpoint_dir:
+        raise SystemExit("--restore requires --checkpoint-dir")
     cfg = config_from_args(args)
     identity = identity_from_args(args)
 
@@ -170,7 +184,8 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                     checkpoint_dir=args.checkpoint_dir,
                     train_ratio=args.train_ratio,
                     min_train_ratio=args.min_train_ratio,
-                    barrier_timeout_s=args.barrier_timeout)
+                    barrier_timeout_s=args.barrier_timeout,
+                    restore=args.restore)
     elif args.role == "actor":
         from apex_tpu.runtime.roles import run_actor
         run_actor(cfg, identity, family=args.family,
@@ -181,23 +196,25 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       episodes=args.episodes, logdir=args.logdir,
                       verbose=args.verbose,
                       barrier_timeout_s=args.barrier_timeout)
-    elif args.role == "dqn":
-        from apex_tpu.training.dqn import DQNTrainer
-        DQNTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
-                   checkpoint_dir=args.checkpoint_dir).train(
-            total_frames=args.total_frames)
-    elif args.role == "aql":
-        from apex_tpu.training.aql import AQLTrainer
-        AQLTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
-                   checkpoint_dir=args.checkpoint_dir).train(
-            total_frames=args.total_frames)
-    elif args.role == "apex":
-        from apex_tpu.training.apex import ApexTrainer
-        ApexTrainer(cfg, logdir=args.logdir, verbose=args.verbose,
-                    checkpoint_dir=args.checkpoint_dir,
-                    train_ratio=args.train_ratio,
-                    min_train_ratio=args.min_train_ratio).train(
-            total_steps=args.total_steps, max_seconds=args.max_seconds)
+    elif args.role in ("dqn", "aql", "apex"):
+        # single-host drivers share one construct -> restore? -> train path
+        if args.role == "dqn":
+            from apex_tpu.training.dqn import DQNTrainer as trainer_cls
+            extra, train_kw = {}, dict(total_frames=args.total_frames)
+        elif args.role == "aql":
+            from apex_tpu.training.aql import AQLTrainer as trainer_cls
+            extra, train_kw = {}, dict(total_frames=args.total_frames)
+        else:
+            from apex_tpu.training.apex import ApexTrainer as trainer_cls
+            extra = dict(train_ratio=args.train_ratio,
+                         min_train_ratio=args.min_train_ratio)
+            train_kw = dict(total_steps=args.total_steps,
+                            max_seconds=args.max_seconds)
+        t = trainer_cls(cfg, logdir=args.logdir, verbose=args.verbose,
+                        checkpoint_dir=args.checkpoint_dir, **extra)
+        if args.restore:
+            t.restore()
+        t.train(**train_kw)
     elif args.role == "enjoy":
         from apex_tpu.training.checkpoint import evaluate_checkpoint
         if not args.checkpoint:

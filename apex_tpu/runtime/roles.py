@@ -87,7 +87,7 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
                 logdir: str | None = None, verbose: bool = False,
                 checkpoint_dir: str | None = None, train_ratio=None,
                 min_train_ratio=None, queue_depth: int = 64,
-                barrier_timeout_s: float = 120.0):
+                barrier_timeout_s: float = 120.0, restore: bool = False):
     """Learner role: barrier -> publish -> fused ingest+train loop.
 
     ``n_peers`` = actors + evaluators expected at the startup barrier
@@ -112,6 +112,8 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
                                      pool=pool)
         else:
             raise ValueError(f"unknown family {family!r}")
+        if restore:
+            trainer.restore()        # newest checkpoint in checkpoint_dir
     except BaseException:
         # the pool binds its ROUTER at construction — unwind it if the
         # trainer never gets far enough for train()'s finally to run

@@ -136,7 +136,9 @@ class CheckpointableTrainer:
                     **self._counters())
 
     def save_checkpoint(self) -> str:
-        assert self.checkpointer is not None, "pass checkpoint_dir"
+        if self.checkpointer is None:
+            raise ValueError("no checkpoint directory configured "
+                             "(pass checkpoint_dir)")
         return self.checkpointer.save(self.steps_rate.total, self._bundle(),
                                       self._meta())
 
@@ -145,9 +147,14 @@ class CheckpointableTrainer:
         replay contents, RNG) + host counters; the learner side of a resumed
         run continues bit-exactly."""
         if path is None:
-            assert self.checkpointer is not None, "pass checkpoint_dir"
+            if self.checkpointer is None:
+                raise ValueError("no checkpoint directory configured "
+                                 "(pass checkpoint_dir)")
             path = self.checkpointer.latest_path()
-            assert path is not None, "no checkpoint found"
+            if path is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found in "
+                    f"{self.checkpointer.directory!r}")
         bundle, meta = restore_bundle(path, self._bundle())
         self.train_state = bundle["train_state"]
         self.replay_state = bundle["replay_state"]
