@@ -55,6 +55,7 @@ class ShmRing:
                                f"shm ring {name!r}")
         self.slot_size = int(lib.apex_shm_slot_size(self._h))
         self._buf = ctypes.create_string_buffer(self.slot_size)
+        self.corrupt_drops = 0   # torn-length payloads disposed by pop
 
     # -- raw ops -----------------------------------------------------------
 
@@ -76,6 +77,9 @@ class ShmRing:
                                     self.slot_size, timeout_ms)
         if rc == -2:  # cannot happen: _buf is slot-sized
             raise ShmRingError("pop buffer smaller than slot")
+        if rc == -3:  # torn length prefix disposed in-place (C-side
+            self.corrupt_drops += 1   # contract) — treat as one lost msg
+            return None
         if rc < 0:
             return None
         return self._buf.raw[:rc]
@@ -180,7 +184,13 @@ class ShmChunkQueue:
 
     def _get(self, timeout_ms: int):
         ring = self._open()
+        corrupt_before = ring.corrupt_drops
         got = ring.pop(timeout_ms=timeout_ms)
+        if got is None and ring.corrupt_drops > corrupt_before:
+            # a torn-length payload was disposed, not a timeout: count it
+            # like an unpickle failure and don't start the starvation clock
+            self.skipped += 1
+            raise queue_lib.Empty
         if got is not None:
             self._starved_since = None
             try:

@@ -219,7 +219,8 @@ int apex_shm_push(void* handle, const uint8_t* data, uint64_t len,
   return 0;
 }
 
-// >=0 = payload length, -1 = timeout, -2 = out buffer too small.
+// >=0 = payload length, -1 = timeout, -2 = out buffer too small,
+// -3 = torn/corrupt length prefix (payload disposed, head advanced).
 int64_t apex_shm_pop(void* handle, uint8_t* out, uint64_t cap,
                      int timeout_ms) {
   auto* r = (Ring*)handle;
@@ -236,6 +237,16 @@ int64_t apex_shm_pop(void* handle, uint8_t* out, uint64_t cap,
   }
   uint64_t len;
   memcpy(&len, slot, 8);
+  if (len > h->slot_size - 8) {
+    // Torn length prefix: a force-skipped producer's resurrected memcpy
+    // raced this slot's reuse (see force-skip contract).  No valid push
+    // can exceed slot_size - 8 (push rejects those with -2), so dispose
+    // of the payload and keep the ring advancing instead of wedging.
+    h->head = t + 1;
+    r->seq[s].v.store(t + h->n_slots, std::memory_order_release);
+    h->disposed.fetch_add(1, std::memory_order_relaxed);
+    return -3;
+  }
   if (len > cap) return -2;
   if (len) memcpy(out, slot + 8, len);
   h->head = t + 1;

@@ -115,6 +115,25 @@ def pallas_eligible(d: int, dtype) -> bool:
     return d % ROW_UNIT == 0 and jnp.dtype(dtype).itemsize in (1, 4)
 
 
+def resolved_mode(frames: jax.Array, mode: str = "auto") -> str:
+    """The concrete path :func:`gather_rows` will take for this operand —
+    ``pallas`` | ``interpret`` | ``xla`` — with the ``APEX_GATHER_MODE``
+    operational override applied.  Benches report this so a silent
+    fallback is visible in the recorded JSON."""
+    if mode != "auto":
+        return mode
+    forced = os.environ.get("APEX_GATHER_MODE")
+    if forced not in (None, "", "auto"):
+        if forced not in ("pallas", "interpret", "xla"):
+            raise ValueError(
+                f"APEX_GATHER_MODE={forced!r}: expected pallas | "
+                f"interpret | xla | auto")
+        return forced
+    d = math.prod(frames.shape[1:])
+    return ("pallas" if frames.ndim == 3 and _on_tpu(frames)
+            and pallas_eligible(d, frames.dtype) else "xla")
+
+
 def gather_rows(frames: jax.Array, ids: jax.Array,
                 mode: str = "auto") -> jax.Array:
     """Row gather from a frame ring; returns flat rows ``[N, D]``.
@@ -126,17 +145,7 @@ def gather_rows(frames: jax.Array, ids: jax.Array,
     ``xla`` force a path (tests, benches).
     """
     d = math.prod(frames.shape[1:])
-    if mode == "auto":
-        forced = os.environ.get("APEX_GATHER_MODE")  # operational override
-        if forced not in (None, "", "auto"):
-            if forced not in ("pallas", "interpret", "xla"):
-                raise ValueError(
-                    f"APEX_GATHER_MODE={forced!r}: expected pallas | "
-                    f"interpret | xla | auto")
-            mode = forced
-        else:
-            mode = ("pallas" if frames.ndim == 3 and _on_tpu(frames)
-                    and pallas_eligible(d, frames.dtype) else "xla")
+    mode = resolved_mode(frames, mode)
     if mode in ("pallas", "interpret"):
         if d % 8:
             raise ValueError(

@@ -146,11 +146,14 @@ class FramePoolReplay(PERMethods):
 
     @property
     def ring_shape(self) -> tuple[int, ...]:
-        """Padded rings are STORED in the kernel's tiled 3-D view
+        """Kernel-eligible rings are STORED in the tiled 3-D view
         ``(F, 8, row_dim/8)``: handing the kernel a pre-shaped operand is
         what keeps the pallas call zero-copy (reshaping inside the fused
-        jit step would materialize the whole ring per step)."""
-        if self.row_dim != self.frame_dim:
+        jit step would materialize the whole ring per step).  Eligibility —
+        not "was padding needed" — decides the view, so exact-fit rows
+        (frame_dim already a ROW_UNIT multiple) take the kernel path too."""
+        from apex_tpu.ops.gather import pallas_eligible
+        if pallas_eligible(self.row_dim, self.frame_dtype):
             return (self.f_capacity, 8, self.row_dim // 8)
         return (self.f_capacity, self.row_dim)
 
@@ -217,7 +220,7 @@ class FramePoolReplay(PERMethods):
                            chunk["n_frames"] - 1)
         fidx = (fpos + frow) % f
         rows = chunk["frames"]
-        if self.row_dim != self.frame_dim:       # tile-align (see row_dim)
+        if len(self.ring_shape) == 3:            # tile-align (see ring_shape)
             rows = jnp.pad(rows, ((0, 0), (0, self.row_dim - self.frame_dim)))
             rows = rows.reshape(kf, 8, self.row_dim // 8)
         frames = state.frames.at[fidx].set(rows)

@@ -160,6 +160,9 @@ class ChunkReceiver:
         # false alarms under a silence threshold.
         self.last_seen: dict[str, float] = {}
         self._chunk_senders: set[str] = set()
+        # guards the two structures above: the receiver thread inserts
+        # while silent_peers() snapshots from the trainer thread
+        self._peers_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -172,10 +175,12 @@ class ChunkReceiver:
                 continue
             ident, payload = self.sock.recv_multipart()
             name = ident.decode(errors="replace")
-            self.last_seen[name] = time.monotonic()
             kind, body = pickle.loads(payload)
+            with self._peers_lock:
+                self.last_seen[name] = time.monotonic()
+                if kind == "chunk":
+                    self._chunk_senders.add(name)
             if kind == "chunk":
-                self._chunk_senders.add(name)
                 # enqueue BEFORE acking: the ack is the credit grant
                 while not self._stop.is_set():
                     try:
@@ -322,8 +327,10 @@ class RemotePool:
         credit-window backpressure can also trip this — the signal means
         "look at this actor", not strictly "dead"."""
         now = time.monotonic()
-        # snapshots: the receiver thread mutates both concurrently
-        senders = set(self.receiver._chunk_senders)
-        seen = list(self.receiver.last_seen.items())
+        # locked snapshot: the receiver thread inserts concurrently, and
+        # an unguarded iteration can raise "dictionary changed size"
+        with self.receiver._peers_lock:
+            senders = set(self.receiver._chunk_senders)
+            seen = list(self.receiver.last_seen.items())
         return sorted(ident for ident, t in seen
                       if ident in senders and now - t > threshold_s)
