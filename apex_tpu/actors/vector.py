@@ -104,7 +104,32 @@ class VectorFamilyBase:
             self._on_reset(i, obs)
 
 
-class VectorDQNWorkerFamily(VectorFamilyBase):
+class VectorChunkFamilyBase(VectorFamilyBase):
+    """Base for B-env families that record through per-slot
+    :class:`~apex_tpu.replay.frame_chunks.FrameChunkBuilder`\\ s: un-stacked
+    envs, builder-managed acting stacks, and chunk-message draining live
+    here ONCE (the DQN and pixel-AQL vector families share them)."""
+
+    builders: list            # set by subclass __init__
+
+    def _make_env(self, seed: int):
+        from apex_tpu.envs.registry import make_env
+        return make_env(self.cfg.env.env_id, self.cfg.env, seed=seed,
+                        max_episode_steps=self.cfg.actor.max_episode_length,
+                        stack_frames=False)
+
+    def _on_reset(self, i: int, obs) -> None:
+        self.builders[i].begin_episode(obs)
+
+    def poll_msgs(self) -> list[dict]:
+        from apex_tpu.actors.pool import drain_builder_chunks
+        out = []
+        for builder in self.builders:
+            out.extend(drain_builder_chunks(builder))
+        return out
+
+
+class VectorDQNWorkerFamily(VectorChunkFamilyBase):
     """B-env DQN acting/recording: the vector counterpart of
     :class:`apex_tpu.actors.pool.DQNWorkerFamily`."""
 
@@ -128,15 +153,6 @@ class VectorDQNWorkerFamily(VectorFamilyBase):
             for _ in range(self.n_envs)
         ]
 
-    def _make_env(self, seed: int):
-        from apex_tpu.envs.registry import make_env
-        return make_env(self.cfg.env.env_id, self.cfg.env, seed=seed,
-                        max_episode_steps=self.cfg.actor.max_episode_length,
-                        stack_frames=False)
-
-    def _on_reset(self, i: int, obs) -> None:
-        self.builders[i].begin_episode(obs)
-
     def step_all(self, params, key) -> list[EpisodeStat]:
         """One batched policy call, then one env.step per slot.  Returns
         stats for slots whose episodes ended (those are auto-reset)."""
@@ -156,15 +172,6 @@ class VectorDQNWorkerFamily(VectorFamilyBase):
                              bool(term), bool(trunc))
             self._finish_step(i, float(reward), bool(term or trunc), stats)
         return stats
-
-    def poll_msgs(self) -> list[dict]:
-        out = []
-        for builder in self.builders:
-            for chunk in builder.poll():
-                out.append({"payload": chunk,
-                            "priorities": chunk.pop("priorities"),
-                            "n_trans": int(chunk["n_trans"])})
-        return out
 
 
 def vector_worker_loop(actor_id: int, cfg: ApexConfig,

@@ -57,6 +57,11 @@ class LearnerConfig:
 
     batch_size: int = 512
     lr: float = 6.25e-5
+    # StepLR(step_size=1000, gamma=0.99) parity (DQN.py:39, ApeX.py:38);
+    # 0 = constant lr (the reference's distributed learner,
+    # origin_repo/learner.py:145)
+    lr_decay_steps: int = 1000
+    lr_decay_rate: float = 0.99
     rmsprop_decay: float = 0.95      # torch RMSprop alpha (ApeX.py:37)
     rmsprop_eps: float = 1.5e-7
     rmsprop_centered: bool = True
@@ -127,6 +132,10 @@ class AQLConfig:
     proposal_lr: float = 1e-4
     q_lr: float = 1e-4
     entropy_coef: float = 0.01
+    # CosineAnnealingLR(T_max=max_step, eta_min=lr/1000) horizon for the
+    # single-process driver (AQL.py:18,48-49); the concurrent driver
+    # ignores it (AQL_dis constructs no schedulers)
+    cosine_lr_steps: int = 1_000_000
 
 
 @dataclass(frozen=True)

@@ -24,10 +24,17 @@ TPU-first redesign (not a port):
   ``repeat``/``reshape`` (``model.py:294-320``) is a broadcast, no data
   motion, and the ``[B*T, feat]`` matmuls land on the MXU.
 
-Discrete action spaces: the reference also routes discrete envs through AQL
-(Categorical proposal, ``model.py:370-376``); this framework covers discrete
-spaces with the purpose-built :class:`~apex_tpu.models.dueling.DuelingDQN`
-path instead — AQL here is the continuous-control family.
+Discrete action spaces (``discrete=True``): the reference routes discrete
+envs through the same machinery with a Categorical proposal
+(``model.py:370-376``) and feeds the candidate INDEX to the Q action-embed
+as a float scalar (``model.py:321-323``).  Same here: candidates are
+``[B, T, 1]`` float index values — the identical tensor contract as the
+continuous ``[B, T, A]`` — so replay storage, the losses, and the actor
+families are shared verbatim between the two families.  The uniform half
+of the candidate set draws DISTINCT actions per row (the reference's
+``np.random.choice(..., replace=False)``, ``model.py:371-373``, done here
+as per-row permutations so batches > 1 are correct), and ``uniform_sample``
+is clamped to the action count at spec build (``model.py:180-184``).
 """
 
 from __future__ import annotations
@@ -46,16 +53,20 @@ class AQLNetwork(nn.Module):
     """Embedding trunk + proposal head + candidate-scoring Q head.
 
     Attributes:
-      action_dim: dimensionality of the Box action space.
+      action_dim: dimensionality of the Box action space, or the action
+        COUNT when ``discrete`` (the proposal head then emits logits).
       action_low/high: box bounds (uniform candidates are drawn here).
       propose_sample/uniform_sample: candidate-set split (``model.py:170``).
       action_var: fixed diagonal variance of the proposal Gaussian.
+      discrete: Categorical proposal over ``action_dim`` actions; candidate
+        tensors are ``[B, T, 1]`` float index values.
       noisy_deterministic: mu-only NoisyDense (eval mode).
     """
 
     action_dim: int
     action_low: float = -1.0
     action_high: float = 1.0
+    discrete: bool = False
     propose_sample: int = 100
     uniform_sample: int = 400
     action_var: float = 0.25
@@ -70,6 +81,14 @@ class AQLNetwork(nn.Module):
         return self.propose_sample + self.uniform_sample
 
     def setup(self):
+        if self.discrete and self.uniform_sample > self.action_dim:
+            # aql_model_spec clamps this (model.py:180-184); a directly
+            # constructed model must fail HERE, not as an opaque shape
+            # mismatch at ingest (total_sample would over-report)
+            raise ValueError(
+                f"discrete uniform_sample={self.uniform_sample} > "
+                f"action count {self.action_dim}: distinct uniform draws "
+                f"are impossible — clamp to the action count")
         dt = self.compute_dtype
         dense = lambda n, name: nn.Dense(  # noqa: E731
             n, dtype=dt, kernel_init=orthogonal_init(),
@@ -110,18 +129,31 @@ class AQLNetwork(nn.Module):
         return nn.relu(self.embed_hidden(self._prep(obs)))
 
     def proposal_mean(self, obs: jax.Array) -> jax.Array:
-        """Gaussian mean of the proposal distribution, ``[B, A]``."""
+        """Gaussian mean of the proposal distribution ``[B, A]`` —
+        Categorical logits ``[B, n]`` when ``discrete``."""
         h = nn.relu(self.proposal_hidden(self.embed(obs)))
         return self.proposal_mu(h).astype(jnp.float32)
 
     def propose(self, obs: jax.Array) -> jax.Array:
-        """Draw the candidate set ``a_mu [B, T, A]`` — uniform box samples
-        first, Gaussian proposals second (``model.py:361-369`` ordering).
-        Needs ``rngs={'sample': key}``."""
+        """Draw the candidate set — uniform samples first, proposal draws
+        second (``model.py:361-376`` ordering).  ``[B, T, A]`` box points,
+        or ``[B, T, 1]`` float index values when ``discrete`` (distinct
+        uniform indices per row + Categorical draws).  Needs
+        ``rngs={'sample': key}``."""
         b = obs.shape[0]
         mu = self.proposal_mean(obs)
         key = self.make_rng("sample")
         k_u, k_p = jax.random.split(key)
+        if self.discrete:
+            n = self.action_dim
+            perm = jax.vmap(lambda k: jax.random.permutation(k, n))(
+                jax.random.split(k_u, b))                    # [B, n]
+            a_uniform = perm[:, :self.uniform_sample]        # distinct
+            a_prop = jax.random.categorical(
+                k_p, mu, axis=-1,
+                shape=(self.propose_sample, b)).T            # [B, P]
+            a_mu = jnp.concatenate([a_uniform, a_prop], axis=1)
+            return a_mu.astype(jnp.float32)[..., None]       # [B, T, 1]
         a_uniform = jax.random.uniform(
             k_u, (b, self.uniform_sample, self.action_dim), jnp.float32,
             self.action_low, self.action_high)
@@ -160,12 +192,20 @@ class AQLNetwork(nn.Module):
 
     def proposal_log_prob(self, obs: jax.Array,
                           actions: jax.Array) -> tuple[jax.Array, jax.Array]:
-        """``(log N(actions | mu(obs), action_var*I), entropy)`` per row.
+        """``(log N(actions | mu(obs), action_var*I), entropy)`` per row —
+        Categorical log-pmf + entropy when ``discrete``
+        (``AQL_dis.py:79-86``; ``model.py:386-388``).
 
-        With the covariance fixed (``model.py:364-365``) the entropy is a
-        constant — kept for parity with the reference's
-        ``-log_prob - lam*entropy`` objective (``AQL_dis.py:84-86``)."""
+        Continuous: with the covariance fixed (``model.py:364-365``) the
+        entropy is a constant — kept for parity with the reference's
+        ``-log_prob - lam*entropy`` objective."""
         mu = self.proposal_mean(obs)
+        if self.discrete:
+            logp = jax.nn.log_softmax(mu, axis=-1)            # [B, n]
+            idx = actions.reshape(actions.shape[0]).astype(jnp.int32)
+            log_prob = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+            entropy = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+            return log_prob, entropy
         var = jnp.float32(self.action_var)
         d = self.action_dim
         log_prob = (-0.5 * jnp.sum((actions - mu) ** 2, axis=-1) / var
@@ -179,7 +219,9 @@ def make_aql_policy_fn(model: AQLNetwork):
     candidates, score them, epsilon-greedy over the candidate index.
     Returns ``(env_actions [B, A], idx [B], a_mu [B, T, A], q [B, T])`` —
     the actor stores ``idx`` + ``a_mu`` so the learner re-scores the exact
-    candidate set."""
+    candidate set.  Discrete models return ``env_actions`` as ``int32 [B]``
+    (the selected candidate's index value), steppable into a Discrete env
+    unchanged."""
 
     def policy(params, obs: jax.Array, epsilon: jax.Array, key: jax.Array):
         k_sample, k_noise, k_eps, k_rand = jax.random.split(key, 4)
@@ -192,6 +234,8 @@ def make_aql_policy_fn(model: AQLNetwork):
         idx = jnp.where(explore, rand, greedy)
         actions = jnp.take_along_axis(
             a_mu, idx[:, None, None], axis=1)[:, 0, :]
+        if model.discrete:
+            actions = actions[:, 0].astype(jnp.int32)
         return actions, idx, a_mu, q
 
     return policy

@@ -96,11 +96,22 @@ def double_dqn_loss(
 
 def make_optimizer(lr: float = 6.25e-5, decay: float = 0.95,
                    eps: float = 1.5e-7, centered: bool = True,
-                   max_grad_norm: float = 40.0) -> optax.GradientTransformation:
-    """Clip-then-RMSprop chain matching ``ApeX.py:37`` + ``utils.py:95``."""
+                   max_grad_norm: float = 40.0,
+                   lr_decay_steps: int | None = 1000,
+                   lr_decay_rate: float = 0.99) -> optax.GradientTransformation:
+    """Clip-then-RMSprop chain matching ``ApeX.py:37`` + ``utils.py:95``,
+    with the single-host drivers' ``StepLR(step_size=1000, gamma=0.99)``
+    reproduced as a staircase exponential decay (``DQN.py:39,71``,
+    ``ApeX.py:38,60``): lr(step) = lr * rate^(step // steps), stepped once
+    per optimizer update exactly like ``scheduler.step()`` per learner
+    iteration.  ``lr_decay_steps=0``/``None`` = constant lr (the
+    reference's distributed learner, ``origin_repo/learner.py:145``)."""
+    schedule = (optax.exponential_decay(lr, lr_decay_steps, lr_decay_rate,
+                                        staircase=True)
+                if lr_decay_steps else lr)
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
-        optax.rmsprop(lr, decay=decay, eps=eps, centered=centered),
+        optax.rmsprop(schedule, decay=decay, eps=eps, centered=centered),
     )
 
 
@@ -187,14 +198,34 @@ def aql_param_labels(params: Any) -> Any:
 
 
 def make_aql_optimizer(q_lr: float = 1e-4, proposal_lr: float = 1e-4,
-                       max_grad_norm: float = 40.0
+                       max_grad_norm: float = 40.0,
+                       cosine_steps: int | None = None
                        ) -> optax.GradientTransformation:
     """Per-group clip + Adam, split by :func:`aql_param_labels` (reference
     clips and steps the two parameter sets independently,
-    ``AQL_dis.py:87-101``, Adam opts ``AQL.py:41-42``)."""
+    ``AQL_dis.py:87-101``, Adam opts ``AQL.py:41-42``).
+
+    ``cosine_steps`` reproduces the reference's
+    ``CosineAnnealingLR(T_max=max_step, eta_min=lr/1000)`` on both groups
+    (``AQL.py:48-49``; ``max_step`` defaults to 1e6, ``AQL.py:18``);
+    ``None``/0 = constant lr (the distributed ``AQL_dis`` path, which
+    never constructs schedulers)."""
     def group(lr):
+        if cosine_steps:
+            lr = cosine_annealing(lr, cosine_steps, lr / 1000.0)
         return optax.chain(optax.clip_by_global_norm(max_grad_norm),
                            optax.adam(lr))
     return optax.multi_transform(
         {"q": group(q_lr), "proposal": group(proposal_lr)},
         aql_param_labels)
+
+
+def cosine_annealing(lr: float, t_max: int, eta_min: float):
+    """torch ``CosineAnnealingLR`` value curve: eta_min + (lr - eta_min) *
+    (1 + cos(pi * t / T_max)) / 2, held at eta_min past ``T_max`` (the
+    closed form; the reference never steps past max_step)."""
+    def schedule(count):
+        t = jnp.minimum(count, t_max)
+        return eta_min + (lr - eta_min) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * t / t_max))
+    return schedule

@@ -15,13 +15,18 @@ Design (BASELINE.json north star; SURVEY.md §7 step 5):
 * Everything — ingest, sample, loss, all-reduce, update, priority write —
   is ONE ``shard_map``-ped, jitted program with donated buffers.
 
-Sampling semantics note: stratified sampling within each shard of an evenly
-ingested stream is statistically equivalent to the reference's global
-stratification when shards receive interleaved actor streams (they do — the
-driver round-robins ingest chunks).  IS weights use the local shard's
-total/min, a pmean'd correction is deliberately NOT applied; with
-round-robin ingest the shard statistics concentrate tightly around the
-global ones.
+Sampling semantics note: each shard samples ``batch/dp`` from its OWN tree,
+so a transition's true inclusion probability is ``leaf / (dp *
+shard_total)`` — under heavy priority skew (one shard holding more mass
+than the others) that deviates from the reference's global stratification;
+round-robin chunk ingest spreads bursts evenly but cannot equalize
+heavy-tailed leaf values.  The IS weights therefore correct for the sampler
+ACTUALLY USED: local total/size (whose product equals the true effective
+global probability times the global size) with one ``pmax``-collectived
+max-weight normalizer so every shard scales identically — an unbiased
+estimator regardless of how mass concentrates, reducing bit-for-bit to the
+single-buffer formula when shards are balanced.  ``tests/test_parallel.py``
+pins both properties under a x1000 priority burst.
 """
 
 from __future__ import annotations
@@ -90,7 +95,7 @@ class ShardedLearner:
 
             rs = core.replay.add(rs, ingest, prios)
             batch, weights, idx = core.replay.sample(
-                rs, key, per_chip_batch, beta)
+                rs, key, per_chip_batch, beta, axis_name="dp")
             new_ts, priorities, metrics = core.update_from_batch(
                 ts, batch, weights, axis_name="dp")
             rs = core.replay.update_priorities(rs, idx, priorities)
@@ -118,7 +123,7 @@ class ShardedLearner:
             rs = jax.tree.map(lambda x: x[0], rs)
             key = jax.random.wrap_key_data(key[0])
             batch, weights, idx = core.replay.sample(
-                rs, key, per_chip_batch, beta)
+                rs, key, per_chip_batch, beta, axis_name="dp")
             new_ts, priorities, metrics = core.update_from_batch(
                 ts, batch, weights, axis_name="dp")
             rs = core.replay.update_priorities(rs, idx, priorities)

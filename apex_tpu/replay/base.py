@@ -32,13 +32,29 @@ class PERMethods:
             max_priority=jnp.maximum(state.max_priority, priorities.max()))
 
     def is_weights(self, state, idx: jax.Array,
-                   beta: float | jax.Array) -> jax.Array:
+                   beta: float | jax.Array,
+                   axis_name: str | None = None) -> jax.Array:
         """IS weights normalized by the max weight from the min-priority
-        leaf (``memory.py:252-298``)."""
+        leaf (``memory.py:252-298``).
+
+        ``axis_name``: inside a ``shard_map`` over a dp-sharded replay.
+        Each shard samples from its OWN tree, so a transition's true
+        inclusion probability is ``leaf / (n_shards * shard_total)`` — the
+        LOCAL total and LOCAL size reproduce exactly that
+        (``local_p * local_size == global_p_eff * global_size``), making
+        the bias correction unbiased for the sampler actually used even
+        when priority mass concentrates unevenly across shards (a pure
+        psum'd-total formula would assume a global sampler that doesn't
+        exist).  Only the max-weight NORMALIZER is collectived (one scalar
+        ``pmax`` over ICI) so every shard scales its loss terms
+        identically; with balanced shards this reduces bit-for-bit to the
+        reference's single-buffer formula (``tests/test_parallel.py``)."""
         total = tree_ops.tree_total(state.sum_tree)
         size = state.size.astype(jnp.float32)
         p_min = tree_ops.tree_min(state.min_tree) / total
         max_weight = (p_min * size) ** (-beta)
+        if axis_name is not None:
+            max_weight = jax.lax.pmax(max_weight, axis_name)
         p_sample = tree_ops.get_leaves(state.sum_tree, idx) / total
         return ((p_sample * size) ** (-beta) / max_weight).astype(jnp.float32)
 

@@ -119,10 +119,12 @@ class DeviceReplay(PERMethods):
     # -- sampling ----------------------------------------------------------
 
     def sample(self, state: ReplayState, key: jax.Array, batch_size: int,
-               beta: float | jax.Array):
-        """Returns ``(batch, weights, idx)``; weights normalized by max weight."""
+               beta: float | jax.Array, axis_name: str | None = None):
+        """Returns ``(batch, weights, idx)``; weights normalized by max
+        weight (globally, via collectives, when ``axis_name`` names a
+        sharded mesh axis — see :meth:`PERMethods.is_weights`)."""
         idx = tree_ops.stratified_sample(state.sum_tree, key, batch_size,
                                          state.size)
         batch = jax.tree.map(lambda s: s[idx], state.storage)
-        weights = self.is_weights(state, idx, beta)
+        weights = self.is_weights(state, idx, beta, axis_name=axis_name)
         return batch, weights, idx

@@ -48,7 +48,8 @@ class FrameChunkBuilder:
                  frame_shape: tuple[int, ...],
                  chunk_transitions: int = 64,
                  frame_margin: int = FRAME_MARGIN,
-                 frame_dtype=np.uint8):
+                 frame_dtype=np.uint8,
+                 extra_shapes: dict | None = None):
         self.n = n_steps
         self.gamma = gamma
         self.s = frame_stack
@@ -57,6 +58,10 @@ class FrameChunkBuilder:
         self.frame_dim = int(np.prod(frame_shape))
         self.K = chunk_transitions
         self.Kf = chunk_transitions + frame_margin
+        # per-transition float32 sidecars captured at the acting step and
+        # emitted with the window HEAD (FramePoolReplay.extra_spec twin:
+        # the AQL family ships its a_mu candidate set here)
+        self.extra_shapes = dict(extra_shapes or {})
 
         # episode state
         self._window: deque = deque()   # (ep_idx, action, reward, q_values)
@@ -77,6 +82,8 @@ class FrameChunkBuilder:
         self._trans: dict[str, list] = {
             k: [] for k in ("action", "reward", "discount", "obs_ref",
                             "next_ref", "q0", "qn")}
+        self._extra_rows: dict[str, list] = {
+            name: [] for name in self.extra_shapes}
 
     def _register_frame(self, ep_idx: int, frame: np.ndarray) -> None:
         self._ep2chunk[ep_idx] = len(self._frames)
@@ -117,19 +124,23 @@ class FrameChunkBuilder:
 
     def add_step(self, action: int, reward: float, q_values: np.ndarray,
                  new_frame: np.ndarray, terminated: bool,
-                 truncated: bool) -> None:
+                 truncated: bool, extras: dict | None = None) -> None:
         """Record one env step: the policy acted on the stack ending at the
         current newest frame; ``new_frame`` is the observation the env
         returned (on truncation it IS the final observation to bootstrap
-        from — no separate argument needed)."""
+        from — no separate argument needed).  ``extras`` must carry one
+        array per declared ``extra_shapes`` name; they ship with the
+        transition whose acting step this is (the window head)."""
         assert self._ep_step >= 0, "begin_episode first"
         self._maybe_flush_for_frames()
         obs_idx = self._ep_step
         self._ep_step += 1
         self._recent.append((self._ep_step, np.asarray(new_frame, self.frame_dtype)))
         self._register_frame(self._ep_step, new_frame)
+        ex = {name: np.asarray((extras or {})[name], np.float32)
+              for name in self.extra_shapes}
         self._window.append((obs_idx, action, float(reward),
-                             np.asarray(q_values, np.float32)))
+                             np.asarray(q_values, np.float32), ex))
 
         if len(self._window) == self.n + 1:
             self._emit_full()
@@ -166,7 +177,7 @@ class FrameChunkBuilder:
 
     def _push(self, head: tuple, ret: float, next_end: int, discount: float,
               qn: np.ndarray) -> None:
-        obs_idx, action, _, q0 = head
+        obs_idx, action, _, q0, extras = head
         t = self._trans
         t["action"].append(action)
         t["reward"].append(np.float32(ret))
@@ -175,6 +186,8 @@ class FrameChunkBuilder:
         t["next_ref"].append(self._stack_refs(next_end))
         t["q0"].append(q0)
         t["qn"].append(qn)
+        for name in self.extra_shapes:
+            self._extra_rows[name].append(extras[name])
         if len(t["action"]) == self.K:
             self._flush()
 
@@ -220,6 +233,10 @@ class FrameChunkBuilder:
             obs_ref=pad_to(t["obs_ref"], self.K, np.int32),
             next_ref=pad_to(t["next_ref"], self.K, np.int32),
         )
+        if self.extra_shapes:
+            chunk["extras"] = {
+                name: pad_to(self._extra_rows[name], self.K, np.float32)
+                for name in self.extra_shapes}
         q0 = np.stack(t["q0"])
         qn = np.stack(t["qn"])
         q_taken = q0[np.arange(n_trans), chunk["action"][:n_trans]]

@@ -74,6 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
     # learner
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--lr", type=float, default=6.25e-5)
+    p.add_argument("--lr-decay-steps", type=int,
+                   default=int(e.get("APEX_LR_DECAY_STEPS", 1000)),
+                   help="StepLR parity (DQN.py:39): lr *= rate every this "
+                        "many learner steps; 0 = constant lr")
+    p.add_argument("--lr-decay-rate", type=float,
+                   default=float(e.get("APEX_LR_DECAY_RATE", 0.99)))
     p.add_argument("--gamma", type=float, default=0.99)
     p.add_argument("--n-steps", type=int, default=3)
     p.add_argument("--target-update-interval", type=int, default=2500)
@@ -138,6 +144,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
         replay=ReplayConfig(capacity=args.capacity, warmup=args.warmup,
                             alpha=args.alpha, beta=args.beta),
         learner=LearnerConfig(batch_size=args.batch_size, lr=args.lr,
+                              lr_decay_steps=args.lr_decay_steps,
+                              lr_decay_rate=args.lr_decay_rate,
                               gamma=args.gamma, n_steps=args.n_steps,
                               target_update_interval=
                               args.target_update_interval,

@@ -284,7 +284,8 @@ class ApexTrainer(ConcurrentTrainer):
         lc = cfg.learner
         optimizer = make_optimizer(
             lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
-            centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm)
+            centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm,
+            lr_decay_steps=lc.lr_decay_steps, lr_decay_rate=lc.lr_decay_rate)
         stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
         self.key, init_key = jax.random.split(self.key)
         self.train_state = create_train_state(

@@ -203,34 +203,59 @@ class AQLTransitionBuilder:
 
 def aql_model_spec(cfg: ApexConfig, env) -> dict:
     """AQLNetwork constructor kwargs from config + env spaces — picklable,
-    shippable to worker processes (the pool's ``model_spec``)."""
+    shippable to worker processes (the pool's ``model_spec``).
+
+    Box spaces get the Gaussian proposal; Discrete spaces the Categorical
+    one with ``uniform_sample`` clamped to the action count (reference
+    ``model.py:176-184``)."""
     space = env.action_space
-    if not hasattr(space, "high"):
-        raise ValueError("AQL drives Box action spaces; use the DQN "
-                         "path for discrete envs")
-    return dict(
-        action_dim=int(np.prod(space.shape)),
-        action_low=float(np.min(space.low)),
-        action_high=float(np.max(space.high)),
+    common = dict(
         propose_sample=cfg.aql.propose_sample,
         uniform_sample=cfg.aql.uniform_sample,
         action_var=cfg.aql.action_var,
         obs_is_image=len(env.observation_space.shape) == 3,
         compute_dtype=jnp.dtype(cfg.learner.compute_dtype),
         scale_uint8=env.observation_space.dtype == np.uint8)
+    if hasattr(space, "high"):                         # Box
+        return dict(
+            action_dim=int(np.prod(space.shape)),
+            action_low=float(np.min(space.low)),
+            action_high=float(np.max(space.high)),
+            **common)
+    if not hasattr(space, "n"):
+        raise ValueError(f"AQL drives Box or Discrete action spaces, "
+                         f"got {type(space).__name__}")
+    n = int(space.n)
+    common["uniform_sample"] = min(cfg.aql.uniform_sample, n)
+    return dict(action_dim=n, discrete=True, **common)
 
 
 def build_aql(cfg: ApexConfig, model_spec: dict, obs_shape, obs_dtype,
-              key: jax.Array):
-    """(model, train_state, replay, replay_state, core) for either driver."""
+              key: jax.Array, cosine_steps: int | None = None,
+              frame_spec: tuple | None = None):
+    """(model, train_state, replay, replay_state, core) for either driver.
+
+    ``cosine_steps``: CosineAnnealingLR horizon for both Adam groups —
+    the single-process driver passes ``cfg.aql.cosine_lr_steps``
+    (``AQL.py:48-49``); the concurrent driver passes 0 (``AQL_dis``
+    constructs no schedulers).
+
+    ``frame_spec``: ``(frame_shape, frame_dtype, frame_stack)`` switches
+    the replay to the frame-pool layout with the ``a_mu`` candidate set as
+    a per-transition sidecar — pixel AQL with frame dedup instead of 2S
+    stacked copies per transition (the concurrent driver passes this for
+    image observations; ingest then expects FrameChunkBuilder chunks)."""
     model = AQLNetwork(**model_spec)
     t = model.total_sample
+    # discrete candidates are index values on a singleton trailing axis
+    a_dim = 1 if model.discrete else model.action_dim
     example_obs = jnp.zeros((1,) + tuple(obs_shape), obs_dtype)
-    example_a_mu = jnp.zeros((1, t, model.action_dim), jnp.float32)
+    example_a_mu = jnp.zeros((1, t, a_dim), jnp.float32)
     init_key, noise_key, sample_key = jax.random.split(key, 3)
     optimizer = make_aql_optimizer(
         q_lr=cfg.aql.q_lr, proposal_lr=cfg.aql.proposal_lr,
-        max_grad_norm=cfg.learner.max_grad_norm)
+        max_grad_norm=cfg.learner.max_grad_norm,
+        cosine_steps=cosine_steps)
     params = model.init(
         {"params": init_key, "noise": noise_key, "sample": sample_key},
         example_obs, example_a_mu, method=AQLNetwork.full_init)
@@ -240,19 +265,33 @@ def build_aql(cfg: ApexConfig, model_spec: dict, obs_shape, obs_dtype,
         opt_state=optimizer.init(params),
         step=jnp.int32(0))
 
-    replay = DeviceReplay(capacity=cfg.replay.capacity,
-                          alpha=cfg.replay.alpha, eps=cfg.replay.eps)
-    example_item = dict(
-        obs=jnp.zeros(tuple(obs_shape), obs_dtype),
-        action=jnp.int32(0), reward=jnp.float32(0),
-        next_obs=jnp.zeros(tuple(obs_shape), obs_dtype),
-        discount=jnp.float32(0),
-        a_mu=jnp.zeros((t, model.action_dim), jnp.float32))
-    check_hbm_budget(replay.hbm_bytes(example_item),
-                     cfg.replay.hbm_budget_gb,
-                     "AQL replay (stacked obs + a_mu candidate sets)",
-                     cfg.replay.capacity)
-    replay_state = replay.init(example_item)
+    if frame_spec is not None:
+        from apex_tpu.replay.frame_pool import FramePoolReplay
+        frame_shape, frame_dtype, frame_stack = frame_spec
+        replay = FramePoolReplay(
+            capacity=cfg.replay.capacity, frame_shape=tuple(frame_shape),
+            frame_stack=frame_stack,
+            frame_dtype=np.dtype(frame_dtype).name,
+            alpha=cfg.replay.alpha, eps=cfg.replay.eps,
+            extra_spec=(("a_mu", (t, a_dim)),))
+        check_hbm_budget(replay.hbm_bytes(), cfg.replay.hbm_budget_gb,
+                         "AQL frame-pool replay (frames + a_mu sidecars)",
+                         cfg.replay.capacity)
+        replay_state = replay.init()
+    else:
+        replay = DeviceReplay(capacity=cfg.replay.capacity,
+                              alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+        example_item = dict(
+            obs=jnp.zeros(tuple(obs_shape), obs_dtype),
+            action=jnp.int32(0), reward=jnp.float32(0),
+            next_obs=jnp.zeros(tuple(obs_shape), obs_dtype),
+            discount=jnp.float32(0),
+            a_mu=jnp.zeros((t, a_dim), jnp.float32))
+        check_hbm_budget(replay.hbm_bytes(example_item),
+                         cfg.replay.hbm_budget_gb,
+                         "AQL replay (stacked obs + a_mu candidate sets)",
+                         cfg.replay.capacity)
+        replay_state = replay.init(example_item)
 
     core = AQLCore(model=model, replay=replay, optimizer=optimizer,
                    batch_size=cfg.learner.batch_size,
@@ -275,7 +314,8 @@ class AQLTrainer(CheckpointableTrainer):
         (self.model, self.train_state, self.replay, self.replay_state,
          self.core) = build_aql(cfg, self.model_spec,
                                 self.env.observation_space.shape,
-                                self.env.observation_space.dtype, build_key)
+                                self.env.observation_space.dtype, build_key,
+                                cosine_steps=cfg.aql.cosine_lr_steps)
         self._train_step = self.core.jit_train_step()
         self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_aql_policy_fn(self.model))
@@ -425,16 +465,39 @@ class AQLApexTrainer(ConcurrentTrainer):
                 and min_train_ratio > train_ratio):
             raise ValueError("min_train_ratio must be <= train_ratio")
 
-        probe = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed)
+        # ONE un-stacked probe covers every case (env construction can be
+        # expensive — ALE ROM loads): model_spec reads spaces that stacking
+        # doesn't change, and the stacked obs shape is FrameStack's own
+        # formula (wrappers.py:198-200) applied analytically.
+        from apex_tpu.envs.registry import unstacked_env_spec
+        probe = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
+                         stack_frames=False)
         self.model_spec = aql_model_spec(cfg, probe)
-        obs_shape = probe.observation_space.shape
-        obs_dtype = probe.observation_space.dtype
+        frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
+            probe, cfg.env)
         probe.close()
+        frame_spec = None
+        if self.model_spec["obs_is_image"]:
+            # pixel AQL rides the frame-pool layout: actor workers switch
+            # to the chunk builder family and replay dedups frames
+            frame_spec = (frame_shape, frame_dtype, frame_stack)
+            obs_shape = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+            obs_dtype = frame_dtype
+        elif cfg.env.frame_stack > 1:
+            # non-image envs are cheap (numpy toys): re-probe stacked so
+            # declared spaces stay authoritative for the odd vector+stack
+            # combination
+            p2 = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed)
+            obs_shape = p2.observation_space.shape
+            obs_dtype = p2.observation_space.dtype
+            p2.close()
+        else:
+            obs_shape, obs_dtype = frame_shape, frame_dtype
 
         self.key, build_key = jax.random.split(self.key)
         (self.model, self.train_state, self.replay, self.replay_state,
          self.core) = build_aql(cfg, self.model_spec, obs_shape, obs_dtype,
-                                build_key)
+                                build_key, frame_spec=frame_spec)
         self._fused = self.core.jit_fused_step()
         self._train = self.core.jit_train_step()
         self._ingest = self.core.jit_ingest()
@@ -447,11 +510,24 @@ class AQLApexTrainer(ConcurrentTrainer):
             # AQL chunks: K x (obs + next_obs + a_mu candidate set +
             # scalars) — size the ring slot from the actual spec
             k = cfg.actor.send_interval
-            obs_bytes = (int(np.prod(obs_shape))
-                         * np.dtype(obs_dtype).itemsize)
-            act_dim = self.model_spec["action_dim"]
-            t = (cfg.aql.propose_sample + cfg.aql.uniform_sample)
-            slot = k * (2 * obs_bytes + 4 * act_dim * (t + 1) + 32) + 65536
+            act_dim = (1 if self.model_spec.get("discrete")
+                       else self.model_spec["action_dim"])
+            t = (self.model_spec["propose_sample"]
+                 + self.model_spec["uniform_sample"])
+            if frame_spec is not None:
+                # frame chunk (single frames + refs) + a_mu sidecar rows
+                from apex_tpu.native.ring import chunk_slot_bytes
+                from apex_tpu.replay.frame_chunks import FRAME_MARGIN
+                frame_shape, frame_dtype, frame_stack = frame_spec
+                slot = chunk_slot_bytes(
+                    frame_dim=int(np.prod(frame_shape)),
+                    frame_dtype_size=np.dtype(frame_dtype).itemsize,
+                    kf=k + FRAME_MARGIN, k=k,
+                    stack=frame_stack) + k * 4 * act_dim * t
+            else:
+                obs_bytes = (int(np.prod(obs_shape))
+                             * np.dtype(obs_dtype).itemsize)
+                slot = k * (2 * obs_bytes + 4 * act_dim * (t + 1) + 32) + 65536
             worker = aql_worker_main
             if cfg.actor.n_envs_per_actor > 1:
                 from apex_tpu.actors.aql import vector_aql_worker_main

@@ -85,6 +85,8 @@ class DQNTrainer(CheckpointableTrainer):
                 self.model, self.cfg.replay.capacity, example_obs, init_key,
                 alpha=self.cfg.replay.alpha, batch_size=lc.batch_size,
                 lr=lc.lr, max_grad_norm=lc.max_grad_norm,
+                lr_decay_steps=lc.lr_decay_steps,
+                lr_decay_rate=lc.lr_decay_rate,
                 rmsprop_decay=lc.rmsprop_decay, rmsprop_eps=lc.rmsprop_eps,
                 rmsprop_centered=lc.rmsprop_centered,
                 replay_eps=self.cfg.replay.eps,

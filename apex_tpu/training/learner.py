@@ -35,7 +35,10 @@ class ReplayLike(Protocol):
 
     def add(self, state, batch, priorities): ...
 
-    def sample(self, state, key, batch_size, beta): ...
+    def sample(self, state, key, batch_size, beta,
+               axis_name: str | None = None): ...
+    # axis_name: the sharded learner passes the dp mesh axis so IS-weight
+    # normalization can collective over it (PERMethods.is_weights)
 
     def update_priorities(self, state, idx, priorities): ...
 
@@ -141,12 +144,16 @@ def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
                   rmsprop_decay: float = 0.95, rmsprop_eps: float = 1.5e-7,
                   rmsprop_centered: bool = True, replay_eps: float = 1e-6,
                   target_update_interval: int = 2500,
+                  lr_decay_steps: int | None = 1000,
+                  lr_decay_rate: float = 0.99,
                   obs_dtype=None, hbm_budget_gb: float | None = None
                   ) -> tuple[LearnerCore, TrainState, ReplayState]:
     """Convenience constructor used by drivers and benches."""
     optimizer = make_optimizer(lr=lr, decay=rmsprop_decay, eps=rmsprop_eps,
                                centered=rmsprop_centered,
-                               max_grad_norm=max_grad_norm)
+                               max_grad_norm=max_grad_norm,
+                               lr_decay_steps=lr_decay_steps,
+                               lr_decay_rate=lr_decay_rate)
     train_state = create_train_state(model, optimizer, key, example_obs)
     replay = DeviceReplay(capacity=replay_capacity, alpha=alpha,
                           eps=replay_eps)

@@ -104,3 +104,49 @@ def test_gradient_flows_only_through_online_q(key):
 
     g = jax.grad(loss_wrt_target)(jnp.asarray(m.w))
     np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-7)
+
+
+def test_lr_schedule_steplr_parity():
+    """make_optimizer's staircase decay must reproduce torch
+    StepLR(step_size, gamma) (DQN.py:39): updates 0..steps-1 at base lr,
+    then one multiplicative decay per boundary."""
+    import optax
+
+    opt_sched = make_optimizer(lr=1e-2, lr_decay_steps=3, lr_decay_rate=0.5)
+    opt_const = make_optimizer(lr=1e-2, lr_decay_steps=0)
+    params = {"w": jnp.ones(4)}
+    s1, s2 = opt_sched.init(params), opt_const.init(params)
+    p1, p2 = params, params
+    g = {"w": jnp.full(4, 0.1)}
+    for _ in range(3):               # before the boundary: identical
+        u1, s1 = opt_sched.update(g, s1, p1)
+        u2, s2 = opt_const.update(g, s2, p2)
+        np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]),
+                                   rtol=1e-6)
+        p1 = optax.apply_updates(p1, u1)
+        p2 = optax.apply_updates(p2, u2)
+    # update #3 (0-based) crosses the first staircase boundary: exactly
+    # one 0.5x decay relative to the constant-lr twin
+    u1, _ = opt_sched.update(g, s1, p1)
+    u2, _ = opt_const.update(g, s2, p2)
+    np.testing.assert_allclose(np.asarray(u1["w"]), 0.5 * np.asarray(u2["w"]),
+                               rtol=1e-6)
+
+
+def test_cosine_annealing_matches_torch_closed_form():
+    """cosine_annealing pins torch CosineAnnealingLR's value curve
+    (AQL.py:48-49): lr(0)=base, lr(T/2)=(base+eta_min)/2, lr(T)=eta_min,
+    then held."""
+    from apex_tpu.ops.losses import cosine_annealing
+
+    base, t_max = 1e-4, 1000
+    sched = cosine_annealing(base, t_max, base / 1000.0)
+    np.testing.assert_allclose(float(sched(0)), base, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(t_max // 2)),
+                               (base + base / 1000.0) / 2, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(t_max)), base / 1000.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(t_max + 500)), base / 1000.0,
+                               rtol=1e-6)
+    # monotone non-increasing on the annealing window
+    vals = [float(sched(t)) for t in range(0, t_max + 1, 100)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
