@@ -83,9 +83,16 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
     elif env_id.startswith("ApexCatch"):
         # Small variant: 7x7 grid rendered to 42x42 (smallest input the
         # Nature conv geometry accepts), 3 balls — a CI-scale task the conv
-        # path can crack in a few thousand updates (6-step credit horizon)
-        env = (toy.CatchEnv(grid=7, pixels=42, balls=3)
-               if "Small" in env_id else toy.CatchEnv())
+        # path can crack in a few thousand updates (6-step credit horizon).
+        # Medium: 11x11 at 44x44, 4 balls — a 10-step credit horizon, the
+        # harder pixel learning certificate standing in for ALE (absent
+        # from this image; ROUND4_NOTES.md).
+        if "Small" in env_id:
+            env = toy.CatchEnv(grid=7, pixels=42, balls=3)
+        elif "Medium" in env_id:
+            env = toy.CatchEnv(grid=11, pixels=44, balls=4)
+        else:
+            env = toy.CatchEnv()
         if max_episode_steps is not None:
             env = wrappers.TimeLimit(env, max_episode_steps)
         if stack_frames and cfg.frame_stack > 1:

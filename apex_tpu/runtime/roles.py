@@ -122,6 +122,24 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
     return trainer.train(total_steps=total_steps, max_seconds=max_seconds)
 
 
+def _join_fleet(comms, name: str, stop_event,
+                timeout_s: float) -> "transport.ParamSubscriber":
+    """Shared actor/evaluator fleet-join: connect the param SUB first, then
+    race the one-shot startup barrier against the param stream
+    (``transport.barrier_wait`` rejoin contract) — a fresh fleet releases
+    via the barrier, a supervisor-respawned peer rejoins within seconds on
+    the first republish, and the learner's ``silent_peers`` report clears
+    on its first chunk.  Returns the connected subscriber; raises (and
+    closes it) when neither signal arrives."""
+    sub = transport.ParamSubscriber(comms)
+    if not transport.barrier_wait(comms, name, stop_event=stop_event,
+                                  timeout_s=timeout_s, rejoin_sub=sub):
+        sub.close()
+        raise TimeoutError(f"{name}: startup barrier timed out and no "
+                           f"params flowing (learner not running)")
+    return sub
+
+
 def run_actor(cfg: ApexConfig, identity: RoleIdentity,
               family: str = "dqn", stop_event=None,
               barrier_timeout_s: float = 120.0) -> None:
@@ -135,13 +153,10 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     stop_event = stop_event or threading.Event()
     name = f"actor-{identity.actor_id}"
     comms = _with_ips(cfg.comms, identity)
-    if not transport.barrier_wait(comms, name, stop_event=stop_event,
-                                  timeout_s=barrier_timeout_s):
-        raise TimeoutError(f"{name}: startup barrier timed out")
+    sub = _join_fleet(comms, name, stop_event, barrier_timeout_s)
     eps = actor_epsilons(identity.n_actors, cfg.actor.eps_base,
                          cfg.actor.eps_alpha)[identity.actor_id]
 
-    sub = transport.ParamSubscriber(comms)
     sender = transport.ChunkSender(comms, name)
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
@@ -200,11 +215,8 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     # actors, evaluator ids carry no semantics (no epsilon ladder slot)
     name = f"evaluator-{identity.actor_id}-{uuid.uuid4().hex[:6]}"
     comms = _with_ips(cfg.comms, identity)
-    if not transport.barrier_wait(comms, name, stop_event=stop_event,
-                                  timeout_s=barrier_timeout_s):
-        raise TimeoutError(f"{name}: startup barrier timed out")
+    sub = _join_fleet(comms, name, stop_event, barrier_timeout_s)
 
-    sub = transport.ParamSubscriber(comms)
     sender = transport.ChunkSender(comms, name)
     log = MetricLogger("evaluator", logdir, verbose=verbose)
     env = make_eval_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed + 7777)

@@ -233,8 +233,18 @@ def barrier_release(comms: CommsConfig, n_peers: int, bind_ip: str = "*",
 
 def barrier_wait(comms: CommsConfig, identity: str,
                  learner_ip: str | None = None, stop_event=None,
-                 timeout_s: float = 120.0) -> bool:
-    """Actor/evaluator side (``actor.py:28-37``): REQ hello, block for go."""
+                 timeout_s: float = 120.0, rejoin_sub=None) -> bool:
+    """Actor/evaluator side (``actor.py:28-37``): REQ hello, block for go.
+
+    ``rejoin_sub``: an already-connected :class:`ParamSubscriber` polled
+    ALONGSIDE the barrier reply.  The barrier exists exactly once, at
+    fleet start (``learner.py:30-54``); a peer respawned by the deploy
+    supervisor (``deploy/actor.sh``) finds it long gone and would
+    otherwise block out the whole timeout.  A running learner republishes
+    params at least every ``10 * publish_min_seconds`` (ConcurrentTrainer),
+    so a received publish proves liveness past the barrier — whichever
+    signal arrives first wins, making post-crash rejoin a ~seconds event
+    instead of a barrier-timeout blackout."""
     sock = _ctx().socket(zmq.REQ)
     sock.setsockopt(zmq.IDENTITY, identity.encode())
     ip = learner_ip or comms.learner_ip
@@ -247,6 +257,8 @@ def barrier_wait(comms: CommsConfig, identity: str,
                 return False
             if sock.poll(100, zmq.POLLIN):
                 sock.recv()
+                return True
+            if rejoin_sub is not None and rejoin_sub.poll(0) is not None:
                 return True
         return False
     finally:

@@ -7,8 +7,19 @@ git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
 cd apex-tpu
 pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
 
+# Supervisor loop mirrors deploy/actor.sh: crashed evaluators respawn
+# (rejoining via the param stream once the startup barrier is gone),
+# capped at 10 respawns/min.
 tmux new -s evaluator -d \
-  "JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
-   --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
-   --barrier-timeout 1800 --verbose; read"
+  "fails=0; window=\$(date +%s); \
+   while true; do \
+     JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
+     --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
+     --barrier-timeout 1800 --verbose; \
+     rc=\$?; now=\$(date +%s); \
+     if [ \$(( now - window )) -gt 60 ]; then fails=0; window=\$now; fi; \
+     fails=\$(( fails + 1 )); \
+     if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
+     echo \"evaluator exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \
+   done; read"
 tmux new -s tensorboard -d "tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

@@ -127,6 +127,7 @@ def test_proposal_loss_matches_gaussian_nll_oracle():
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_two_optimizer_isolation():
     """The proposal loss moves ONLY proposal params; the Q loss moves only
     the rest (reference interleaved zero_grad/step, AQL_dis.py:87-101)."""
@@ -193,6 +194,7 @@ def test_transition_builder_oracle():
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_aql_apex_pipeline_mechanics():
     """Distributed AQL (C9+C12): worker processes act through the
     proposal+Q policy and ship a_mu-carrying chunks; the learner ingests
@@ -213,6 +215,7 @@ def test_aql_apex_pipeline_mechanics():
     assert np.isfinite(t.evaluate(episodes=1, max_steps=50))
 
 
+@pytest.mark.slow
 def test_aql_apex_vector_actors():
     """Vectorized AQL actors: 1 process x 4 env slots act through ONE
     batched propose+score call; slots carry global ladder ids; the
@@ -234,6 +237,7 @@ def test_aql_apex_vector_actors():
     assert all(not p.is_alive() for p in t.pool.procs)
 
 
+@pytest.mark.slow
 def test_aql_learns_continuous_nav():
     """AQL must beat random play on ContinuousNav: random returns ~-40,
     competent proposals reach > -20 within a small CI budget."""
@@ -325,6 +329,7 @@ def test_discrete_policy_returns_int_actions(key):
     np.testing.assert_array_equal(np.asarray(act), chosen.astype(np.int32))
 
 
+@pytest.mark.slow
 def test_discrete_aql_trainer_mechanics():
     """The full single-process AQL pipeline on a Discrete env (CartPole):
     spec routing, candidate storage, fused two-loss step, eval — the
@@ -345,6 +350,7 @@ def test_discrete_aql_trainer_mechanics():
     assert np.isfinite(t.evaluate(episodes=2, max_steps=50))
 
 
+@pytest.mark.slow
 def test_aql_pixel_frame_pool_pipeline():
     """Pixel AQL end to end (VERDICT r3 weak #4): 84x84x4 uint8 Catch
     through the FRAME-POOL replay with a_mu sidecars — actor workers use

@@ -10,6 +10,7 @@ EXACTLY the trajectory the uninterrupted one would have taken.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.config import small_test_config
 from apex_tpu.training.checkpoint import (Checkpointer, config_from_meta,
@@ -27,6 +28,7 @@ def _pure_train_steps(tr, m: int) -> None:
             tr.train_state, tr.replay_state, k, jnp.float32(0.5))
 
 
+@pytest.mark.slow
 def test_kill_restore_resume_is_bit_exact(tmp_path):
     cfg = small_test_config(capacity=256, batch_size=16, n_actors=1)
     t1 = DQNTrainer(cfg, checkpoint_dir=str(tmp_path / "ck"))
@@ -71,6 +73,7 @@ def test_evaluate_checkpoint_without_trainer(tmp_path):
     assert np.isfinite(score) and score > 0  # CartPole reward >= episode len
 
 
+@pytest.mark.slow
 def test_evaluate_checkpoint_aql_family(tmp_path):
     """enjoy dispatches on the spec: AQL checkpoints rebuild AQLNetwork
     and drive Box actions — no trainer object, no family flag."""
@@ -105,6 +108,7 @@ def test_config_meta_roundtrip():
     assert config_from_meta(config_to_meta(cfg)) == cfg
 
 
+@pytest.mark.slow
 def test_sharded_trainer_checkpoint_roundtrip(tmp_path):
     """dp=8: the full bundle (replicated train state + 8 sharded frame-pool
     replicas) saves, restores into a FRESH trainer, and the restored state
@@ -141,6 +145,7 @@ def test_sharded_trainer_checkpoint_roundtrip(tmp_path):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_cli_kill_minus_nine_and_resume(tmp_path):
     """The operator drill (VERDICT A4): SIGKILL a running `--role apex`
     learner mid-run, relaunch with --restore, and the run continues from

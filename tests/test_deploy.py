@@ -1,0 +1,104 @@
+"""Deploy template validation (C19 / VERDICT r3 item 8).
+
+No terraform binary ships in this image, so ``terraform validate`` can't
+run in CI; this is a structural checker over the HCL + bootstrap templates
+that fails on the defect classes a broken edit would introduce: unbalanced
+blocks, references to undeclared variables, template placeholders nobody
+supplies, dangling resource references, and firewall ports drifting from
+the CommsConfig defaults the roles actually bind (reference topology:
+``origin_repo/deploy/deploy.tf``).
+"""
+
+import re
+from pathlib import Path
+
+DEPLOY = Path(__file__).resolve().parent.parent / "deploy"
+
+
+def _strip_comments_and_strings(text: str) -> str:
+    """Remove # comments; keep string contents (brace balance includes
+    interpolation braces, which HCL nests legally)."""
+    return re.sub(r"#[^\n]*", "", text)
+
+
+def test_hcl_braces_and_quotes_balanced():
+    for tf in sorted(DEPLOY.glob("*.tf")):
+        text = _strip_comments_and_strings(tf.read_text())
+        assert text.count("{") == text.count("}"), f"{tf.name}: brace count"
+        assert text.count('"') % 2 == 0, f"{tf.name}: unbalanced quotes"
+
+
+def _main_and_vars():
+    main = (DEPLOY / "main.tf").read_text()
+    variables = (DEPLOY / "variables.tf").read_text()
+    declared = set(re.findall(r'variable\s+"(\w+)"', variables))
+    referenced = set(re.findall(r"\bvar\.(\w+)", main))
+    return main, declared, referenced
+
+
+def test_variables_declared_and_used():
+    _, declared, referenced = _main_and_vars()
+    undeclared = referenced - declared
+    assert not undeclared, f"main.tf references undeclared {undeclared}"
+    unused = declared - referenced
+    assert not unused, f"variables.tf declares unused {unused}"
+
+
+def test_templatefile_references_and_placeholders():
+    """Every templatefile() call points at an existing script, supplies
+    every ``${name}`` placeholder the script uses, and passes no unused
+    keys.  Bash's own ``$(...)``/``\\$x`` forms don't collide: only bare
+    ``${identifier}`` is a terraform placeholder."""
+    main = (DEPLOY / "main.tf").read_text()
+    calls = re.findall(
+        r'templatefile\("\$\{path\.module\}/([\w.]+)",\s*\{(.*?)\}\s*\)',
+        main, re.DOTALL)
+    assert len(calls) >= 3, "learner/actor/evaluator templates expected"
+    for fname, body in calls:
+        script = DEPLOY / fname
+        assert script.exists(), f"templatefile target missing: {fname}"
+        keys = set(re.findall(r"(\w+)\s*=", body))
+        placeholders = set(re.findall(r"\$\{(\w+)\}", script.read_text()))
+        missing = placeholders - keys
+        assert not missing, f"{fname}: unsupplied placeholders {missing}"
+        unused = keys - placeholders
+        assert not unused, f"{fname}: keys passed but never used {unused}"
+
+
+def test_resource_references_resolve():
+    main, _, _ = _main_and_vars()
+    defined = {f"{t}.{n}" for t, n in
+               re.findall(r'resource\s+"(\w+)"\s+"(\w+)"', main)}
+    for ref in re.findall(
+            r"\b(google_[a-z0-9_]+\.\w+)\.", main):
+        assert ref in defined, f"dangling resource reference {ref}"
+
+
+def test_firewall_ports_match_comms_config():
+    """The opened ports must be exactly what the roles bind: chunk ingest,
+    param PUB, barrier (CommsConfig defaults) + tensorboard.  The
+    reference additionally opened the replay server's 51002/51003
+    (deploy.tf:64-126); those MUST be gone — the replay server is
+    dissolved."""
+    from apex_tpu.config import CommsConfig
+
+    main = (DEPLOY / "main.tf").read_text()
+    m = re.search(r'ports\s*=\s*\[([^\]]*)\]', main)
+    assert m, "no firewall ports list"
+    ports = {int(p) for p in re.findall(r'"(\d+)"', m.group(1))}
+    c = CommsConfig()
+    assert {c.batch_port, c.param_port, c.barrier_port} <= ports
+    assert 6006 in ports                     # tensorboard
+    assert c.prios_port not in ports and c.sample_port not in ports, \
+        "replay-server ports resurrected — that server is dissolved"
+
+
+def test_bootstrap_scripts_have_supervisor_loops():
+    """Crashed remote roles must respawn (VERDICT r3 weak #6): the actor
+    and evaluator bootstraps carry the rate-limited supervisor loop that
+    pairs with roles.py's param-stream rejoin path."""
+    for name in ("actor.sh", "evaluator.sh"):
+        text = (DEPLOY / name).read_text()
+        assert "while true" in text, f"{name}: no respawn loop"
+        assert "sleep 5" in text, f"{name}: no respawn backoff"
+        assert "fails" in text, f"{name}: no crash-loop rate limit"

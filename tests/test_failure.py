@@ -9,11 +9,13 @@ concurrent trainer does this continuously during training.
 import time
 
 import numpy as np
+import pytest
 
 from apex_tpu.config import small_test_config
 from apex_tpu.training.apex import ApexTrainer, dqn_model_spec
 
 
+@pytest.mark.slow
 def test_pool_detects_and_respawns_dead_worker():
     from apex_tpu.actors.pool import ActorPool
 
@@ -56,6 +58,7 @@ def _crashing_worker(actor_id, cfg, model_spec, chunk_queue, param_queue,
     raise RuntimeError("boom")      # deterministic startup crash
 
 
+@pytest.mark.slow
 def test_respawn_budget_stops_crash_loops():
     """A worker that dies on every start exhausts its respawn budget and
     drops out of dead_workers() — no infinite 5-second crash loop."""
@@ -107,6 +110,7 @@ def _params(cfg):
     return jax.device_get(ts.params)
 
 
+@pytest.mark.slow
 def test_trainer_survives_worker_death():
     """Kill a worker mid-training: the trainer logs the respawn and the
     run completes its step budget with a full fleet."""

@@ -4,6 +4,7 @@ and a small end-to-end learning test on the numpy CartPole env."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.config import small_test_config
 from apex_tpu.models.dueling import DuelingDQN
@@ -83,6 +84,7 @@ def test_fused_step_ingests_and_trains(key):
     assert int(rs2.size) == 48 and int(ts2.step) == 1
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole():
     """End-to-end slice: reward must clearly beat random play.
 

@@ -75,6 +75,7 @@ def _producer(name: str, worker: int, n_msgs: int) -> None:
     r.close()
 
 
+@pytest.mark.slow
 def test_ring_many_producers_one_consumer():
     """3 producer processes, one consuming parent: every message arrives
     exactly once, per-producer order preserved (MPSC contract)."""
@@ -209,6 +210,7 @@ def test_chunk_queue_facade():
         q.close()
 
 
+@pytest.mark.slow
 def test_actor_pool_uses_shm_plane():
     """ApexTrainer's pool rides the native ring end-to-end: chunks from real
     worker processes cross shared memory, training proceeds, shutdown is

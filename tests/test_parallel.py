@@ -4,6 +4,7 @@ params replicated, and matches single-device grad math."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.models.dueling import DuelingDQN
 from apex_tpu.parallel.learner import ShardedLearner
@@ -118,6 +119,7 @@ def test_dp8_update_matches_single_device_math(key):
                                    rtol=2e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_apex_trainer_on_virtual_mesh():
     """ApexTrainer(mesh_shape=(8,)): sharded frame-pool replay + aggregated
     chunk ingest + pmean training, end to end with real actor processes."""

@@ -13,6 +13,7 @@ import os
 import socket
 
 import numpy as np
+import pytest
 
 from apex_tpu.config import RoleIdentity, small_test_config
 
@@ -52,6 +53,7 @@ def _evaluator_main(cfg):
                   max_steps=200, barrier_timeout_s=60)
 
 
+@pytest.mark.slow
 def test_localhost_all_roles_topology():
     n_actors = 2
     cfg = _test_config(n_actors)
@@ -101,6 +103,7 @@ def test_localhost_all_roles_topology():
             p.join(timeout=10)
 
 
+@pytest.mark.slow
 def test_topology_sharded_learner_vector_actors():
     """The flagship scale topology in miniature: VECTORIZED actors (2
     processes x 3 env slots) feed the dp=8 SHARDED learner over real TCP —
@@ -200,3 +203,93 @@ def test_cli_parser_roles_and_env_twins(monkeypatch):
     cfg4 = config_from_args(
         build_parser().parse_args(["--n-envs-per-actor", "32"]))
     assert cfg4.actor.n_envs_per_actor == 32
+
+
+@pytest.mark.slow
+def test_actor_rejoin_after_kill_clears_silent_peers():
+    """The supervisor-respawn contract (deploy/actor.sh + roles.py
+    _rejoin_via_params): kill the only actor mid-run; the learner's
+    silent_peers flags it; a respawned actor with the SAME identity
+    rejoins PAST the long-gone startup barrier by observing the param
+    stream, resumes shipping chunks, and silent_peers clears."""
+    import threading
+    import time as time_mod
+
+    import pytest
+
+    from apex_tpu.runtime.transport import RemotePool
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = _test_config(1)
+    cfg = cfg.replace(replay=dataclasses.replace(cfg.replay, warmup=128))
+    ctx = mp.get_context("spawn")
+    pool = RemotePool(cfg.comms, n_peers=1, barrier_timeout_s=60)
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.1, train_ratio=8.0,
+                          pool=pool)
+
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    try:
+        actor = ctx.Process(target=_actor_main, args=(cfg, 0, 1),
+                            daemon=True)
+        actor.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    done = threading.Event()
+    respawn = None
+    try:
+        t = threading.Thread(
+            target=lambda: (trainer.train(total_steps=10 ** 9,
+                                          max_seconds=300), done.set()),
+            daemon=True)
+        t.start()
+
+        def wait_for(cond, timeout, what):
+            deadline = time_mod.monotonic() + timeout
+            while time_mod.monotonic() < deadline:
+                if cond():
+                    return
+                time_mod.sleep(0.25)
+            pytest.fail(f"timed out waiting for {what}")
+
+        # phase 1: the actor joined and ships chunks
+        wait_for(lambda: trainer.ingested > 0, 60, "first chunks")
+        assert pool.silent_peers(threshold_s=5.0) == []
+
+        # phase 2: SIGKILL the actor; it goes silent
+        actor.kill()
+        actor.join(timeout=10)
+        wait_for(lambda: pool.silent_peers(threshold_s=3.0) == ["actor-0"],
+                 30, "silence detection")
+
+        # phase 3: respawn with the same identity — the barrier is gone,
+        # so this exercises the param-stream rejoin path
+        ingested_before = trainer.ingested
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        try:
+            respawn = ctx.Process(target=_actor_main, args=(cfg, 0, 1),
+                                  daemon=True)
+            respawn.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        wait_for(lambda: pool.silent_peers(threshold_s=3.0) == []
+                 and trainer.ingested > ingested_before,
+                 90, "rejoin + silence clearing")
+    finally:
+        for p in (actor, respawn):
+            if p is not None:
+                p.terminate()
+                p.join(timeout=10)
+        done.wait(timeout=60)   # let train() unwind and pool.cleanup() run

@@ -4,6 +4,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from apex_tpu.actors.pool import actor_epsilons
 from apex_tpu.actors.vector import VectorDQNWorkerFamily
@@ -95,6 +96,7 @@ def test_vector_epsilons_span_global_ladder():
     assert (np.diff(all_eps) < 0).all()   # monotone across the whole fleet
 
 
+@pytest.mark.slow
 def test_apex_trainer_with_vector_actors():
     """End-to-end: ApexTrainer drives vector workers (1 process x 4 envs)
     through the same queues, warms up, trains, and shuts down cleanly."""
