@@ -11,6 +11,7 @@ come from different actors, land on different chips.
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 
@@ -67,9 +68,11 @@ class ChunkAggregator:
             if len(self._buf) < self.n_dp:
                 break
             msgs, self._buf = self._buf[:self.n_dp], self._buf[self.n_dp:]
-            payload = {k: np.stack([np.asarray(m["payload"][k])
-                                    for m in msgs])
-                       for k in msgs[0]["payload"]}
+            # tree-stack: payloads may nest (frame chunks carry an
+            # "extras" dict of per-transition sidecars)
+            payload = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[m["payload"] for m in msgs])
             out.append({
                 "payload": payload,
                 "priorities": np.stack([np.asarray(m["priorities"])

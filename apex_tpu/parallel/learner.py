@@ -50,7 +50,13 @@ def _stack_leading(tree_obj: Any, n: int) -> Any:
 
 @dataclass(frozen=True)
 class ShardedLearner:
-    """Wraps a :class:`LearnerCore` with a dp-sharded execution plan."""
+    """Wraps a learner core with a dp-sharded execution plan.
+
+    Works for any core with the :class:`LearnerCore` method shape
+    (``replay``/``batch_size``/``update_from_batch``); cores whose update
+    consumes a PRNG key (AQL's NoisyNet draws) set ``update_needs_key =
+    True`` and the per-chip body splits its key between sampling and the
+    update, mirroring ``AQLCore.train_step``."""
 
     core: LearnerCore
     mesh: Mesh
@@ -58,6 +64,17 @@ class ShardedLearner:
     @property
     def n_dp(self) -> int:
         return self.mesh.shape["dp"]
+
+    @property
+    def _needs_key(self) -> bool:
+        return getattr(self.core, "update_needs_key", False)
+
+    def _update(self, ts, batch, weights, key):
+        if self._needs_key:
+            return self.core.update_from_batch(ts, batch, weights, key,
+                                               axis_name="dp")
+        return self.core.update_from_batch(ts, batch, weights,
+                                           axis_name="dp")
 
     # -- state construction ------------------------------------------------
 
@@ -67,7 +84,12 @@ class ShardedLearner:
         Total capacity = ``core.replay.capacity * n_dp`` — capacity scales
         with the slice, which is exactly how HBM grows.
         """
-        shard = self.core.replay.init(example_item)
+        return self.shard_replay_state(self.core.replay.init(example_item))
+
+    def shard_replay_state(self, shard: ReplayState) -> ReplayState:
+        """Tile a freshly-initialized single-shard state onto the sharded
+        leading axis (drivers that already built their replay state pass
+        it here instead of re-deriving an example item)."""
         stacked = _stack_leading(shard, self.n_dp)
         sharding = NamedSharding(self.mesh, P("dp"))
         return jax.tree.map(
@@ -93,11 +115,15 @@ class ShardedLearner:
             prios = prios[0]
             key = jax.random.wrap_key_data(key[0])
 
+            if self._needs_key:
+                key, k_update = jax.random.split(key)
+            else:
+                k_update = None
             rs = core.replay.add(rs, ingest, prios)
             batch, weights, idx = core.replay.sample(
                 rs, key, per_chip_batch, beta, axis_name="dp")
-            new_ts, priorities, metrics = core.update_from_batch(
-                ts, batch, weights, axis_name="dp")
+            new_ts, priorities, metrics = self._update(
+                ts, batch, weights, k_update)
             rs = core.replay.update_priorities(rs, idx, priorities)
             rs = jax.tree.map(lambda x: x[None], rs)    # restore shard axis
             return new_ts, rs, metrics
@@ -122,10 +148,14 @@ class ShardedLearner:
                      beta: jax.Array):
             rs = jax.tree.map(lambda x: x[0], rs)
             key = jax.random.wrap_key_data(key[0])
+            if self._needs_key:
+                key, k_update = jax.random.split(key)
+            else:
+                k_update = None
             batch, weights, idx = core.replay.sample(
                 rs, key, per_chip_batch, beta, axis_name="dp")
-            new_ts, priorities, metrics = core.update_from_batch(
-                ts, batch, weights, axis_name="dp")
+            new_ts, priorities, metrics = self._update(
+                ts, batch, weights, k_update)
             rs = core.replay.update_priorities(rs, idx, priorities)
             rs = jax.tree.map(lambda x: x[None], rs)
             return new_ts, rs, metrics

@@ -117,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "APEX_RESTORE env var")
     p.add_argument("--episodes", type=int, default=0,
                    help="evaluator/enjoy episode budget (0 = forever)")
+    p.add_argument("--render", choices=["ascii", "save"], default=None,
+                   help="enjoy role: terminal ASCII rendering, or capture "
+                        "observations to --render-dir as per-episode .npy "
+                        "stacks (enjoy.py:29-48 on headless hosts)")
+    p.add_argument("--render-dir", default=e.get("APEX_RENDER_DIR"))
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--barrier-timeout", type=float, default=120.0)
     return p
@@ -227,8 +232,13 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
         from apex_tpu.training.checkpoint import evaluate_checkpoint
         if not args.checkpoint:
             raise SystemExit("--checkpoint required for enjoy")
+        hook = None
+        if args.render:
+            from apex_tpu.utils.render import make_render_hook
+            hook = make_render_hook(args.render, args.render_dir)
         score = evaluate_checkpoint(args.checkpoint,
-                                    episodes=args.episodes or 10)
+                                    episodes=args.episodes or 10,
+                                    render_hook=hook)
         print(f"enjoy: mean episode reward {score:.2f}")
     return 0
 

@@ -88,6 +88,19 @@ class ConcurrentTrainer(CheckpointableTrainer):
         host_params = jax.device_get(self.train_state.params)
         self.pool.publish_params(self.param_version, host_params)
 
+    # -- cooperative shutdown ---------------------------------------------
+
+    _stop_requested = None      # lazily a threading.Event (request_stop)
+
+    def request_stop(self) -> None:
+        """Ask a running :meth:`train` (possibly in another thread) to
+        return at its next loop iteration — graceful shutdown without
+        waiting out ``max_seconds``."""
+        import threading
+        if self._stop_requested is None:
+            self._stop_requested = threading.Event()
+        self._stop_requested.set()
+
     # -- main loop ---------------------------------------------------------
 
     def train(self, total_steps: int, max_seconds: float = 3600.0,
@@ -111,7 +124,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
             while self.steps_rate.total < target_steps:
                 now = time.monotonic()
-                if now > t_end:
+                stop = self._stop_requested
+                if now > t_end or (stop is not None and stop.is_set()):
                     break
                 warm = self.ingested >= cfg.replay.warmup
                 consumed = self.steps_rate.total * self.core.batch_size

@@ -54,6 +54,9 @@ class AQLCore:
     batch_size: int = 64
     target_update_interval: int = 500
     entropy_coef: float = 0.01
+    # the update consumes a PRNG key (NoisyNet draws) — ShardedLearner
+    # splits its per-chip key between sampling and the update
+    update_needs_key = True
 
     # -- functional model hooks -------------------------------------------
 
@@ -109,10 +112,14 @@ class AQLCore:
             lambda: jax.tree.map(jnp.copy, params),
             lambda: ts.target_params)
 
+        q_mean, td_mean = aux.q_taken.mean(), aux.td_abs.mean()
+        if axis_name is not None:
+            q_mean = jax.lax.pmean(q_mean, axis_name)
+            td_mean = jax.lax.pmean(td_mean, axis_name)
         metrics = {"loss": loss_q, "loss_proposal": loss_p,
                    "grad_norm": optax.global_norm(grads),
-                   "q_mean": aux.q_taken.mean(),
-                   "td_mean": aux.td_abs.mean()}
+                   "q_mean": q_mean,
+                   "td_mean": td_mean}
         ts = TrainState(params=params, target_params=target_params,
                         opt_state=opt_state, step=step)
         return ts, aux.priorities, metrics
@@ -498,9 +505,6 @@ class AQLApexTrainer(ConcurrentTrainer):
         (self.model, self.train_state, self.replay, self.replay_state,
          self.core) = build_aql(cfg, self.model_spec, obs_shape, obs_dtype,
                                 build_key, frame_spec=frame_spec)
-        self._fused = self.core.jit_fused_step()
-        self._train = self.core.jit_train_step()
-        self._ingest = self.core.jit_ingest()
         eval_model = self.model.clone(noisy_deterministic=True)
         self._eval_policy = jax.jit(make_aql_policy_fn(eval_model))
 
@@ -536,6 +540,14 @@ class AQLApexTrainer(ConcurrentTrainer):
                 cfg, self.model_spec,
                 chunk_transitions=cfg.actor.send_interval,
                 worker_fn=worker, shm_slot_bytes=slot)
+
+        self.n_dp = int(np.prod(cfg.learner.mesh_shape))
+        if self.n_dp > 1:
+            self._init_sharded()
+        else:
+            self._fused = self.core.jit_fused_step()
+            self._train = self.core.jit_train_step()
+            self._ingest = self.core.jit_ingest()
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()
@@ -543,6 +555,40 @@ class AQLApexTrainer(ConcurrentTrainer):
         self.param_version = 0
         self.checkpointer = (Checkpointer(checkpoint_dir)
                              if checkpoint_dir else None)
+
+    def _init_sharded(self) -> None:
+        """dp > 1: shard the AQL replay per chip (ShardedLearner splits the
+        per-chip key between sampling and the NoisyNet update via
+        ``AQLCore.update_needs_key``), pmean grads over ICI, round-robin
+        whole chunks across shards — the same plan as the DQN flagship
+        (``ApexTrainer._init_sharded``)."""
+        from apex_tpu.parallel.aggregate import ChunkAggregator
+        from apex_tpu.parallel.learner import ShardedLearner
+        from apex_tpu.parallel.mesh import make_mesh
+
+        n = self.n_dp
+        devices = jax.devices()
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
+                f"devices, have {len(devices)}")
+        mesh = make_mesh(dp=n, devices=devices[:n])
+        sl = self.sharded = ShardedLearner(self.core, mesh)
+        self.replay_state = sl.shard_replay_state(self.replay_state)
+        self.train_state = sl.replicate_train_state(self.train_state)
+        self.pool = ChunkAggregator(self.pool, n)
+
+        fused = sl.make_fused_step()
+        train = sl.make_train_step()
+        ingest = sl.make_ingest()
+
+        def _fused(ts, rs, payload, prios, key, beta):
+            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
+
+        def _train(ts, rs, key, beta):
+            return train(ts, rs, sl.device_keys(key), beta)
+
+        self._fused, self._train, self._ingest = _fused, _train, ingest
 
     def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
                  max_steps: int = 1000) -> float:

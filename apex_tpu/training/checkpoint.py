@@ -251,5 +251,8 @@ def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
             done = term or trunc
             steps += 1
         rewards.append(total)
+        flush = getattr(render_hook, "flush_episode", None)
+        if flush is not None:      # save-mode hooks write one file/episode
+            flush()
     env.close()
     return float(np.mean(rewards))

@@ -1,0 +1,89 @@
+"""Rendering for the ``enjoy`` role (reference ``origin_repo/enjoy.py:29-48``).
+
+The reference calls ``env.render()`` to a screen; this image (and any
+cluster host) is headless, so the equivalents are terminal ASCII rendering
+and frame capture to disk:
+
+* ``ascii`` — pixel observations are downsampled to a character raster and
+  redrawn in place (ANSI cursor-home), vector observations print as one
+  line per step;
+* ``save`` — every observation is appended to an in-memory episode buffer
+  and written as ``.npy`` stacks per episode (dependency-free; convert to
+  video offline with any tool).
+
+``make_render_hook`` returns a callable matching
+:func:`apex_tpu.training.checkpoint.evaluate_checkpoint`'s ``render_hook``
+contract (called with the raw observation every step).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+# dark -> bright luminance ramp
+_RAMP = " .:-=+*#%@"
+
+
+def ascii_frame(obs: np.ndarray, width: int = 64) -> str:
+    """One pixel observation -> a character raster.  Stacked frames render
+    their NEWEST channel (the current frame; stacks are oldest-first)."""
+    arr = np.asarray(obs)
+    if arr.ndim == 3:
+        arr = arr[..., -1]
+    h, w = arr.shape
+    cols = min(width, w)
+    rows = max(1, int(h * cols / w / 2))      # terminal cells are ~2:1
+    ys = (np.arange(rows) * (h / rows)).astype(int)
+    xs = (np.arange(cols) * (w / cols)).astype(int)
+    small = arr[ys][:, xs].astype(np.float32)
+    lo, hi = float(small.min()), float(small.max())
+    norm = (small - lo) / (hi - lo) if hi > lo else np.zeros_like(small)
+    idx = (norm * (len(_RAMP) - 1)).astype(int)
+    return "\n".join("".join(_RAMP[i] for i in row) for row in idx)
+
+
+def make_render_hook(mode: str, out_dir: str | None = None,
+                     stream=None):
+    """``mode``: ``ascii`` | ``save`` (requires ``out_dir``).  Returns
+    ``hook(obs)``; the hook carries a ``flush_episode()`` method the enjoy
+    loop calls between episodes (save mode writes one stack per episode)."""
+    stream = stream or sys.stdout
+
+    if mode == "ascii":
+        def hook(obs):
+            arr = np.asarray(obs)
+            if arr.ndim >= 2:
+                # cursor home + clear-to-end redraws the raster in place
+                stream.write("\x1b[H\x1b[J" + ascii_frame(arr) + "\n")
+            else:
+                stream.write(" ".join(f"{v:+.3f}" for v in arr.ravel())
+                             + "\n")
+            stream.flush()
+
+        hook.flush_episode = lambda: None
+        return hook
+
+    if mode == "save":
+        if not out_dir:
+            raise ValueError("render mode 'save' needs --render-dir")
+        os.makedirs(out_dir, exist_ok=True)
+        frames: list[np.ndarray] = []
+        episode = [0]
+
+        def hook(obs):
+            frames.append(np.asarray(obs).copy())
+
+        def flush_episode():
+            if frames:
+                path = os.path.join(out_dir, f"episode_{episode[0]:03d}.npy")
+                np.save(path, np.stack(frames))
+                frames.clear()
+                episode[0] += 1
+
+        hook.flush_episode = flush_episode
+        return hook
+
+    raise ValueError(f"unknown render mode {mode!r}: ascii | save")

@@ -8,22 +8,24 @@ cd apex-tpu
 pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
 
 # Supervisor loop: a crashed actor is relaunched after a short backoff —
-# the role's rejoin path (runtime/roles.py:_rejoin_via_params) lets the
-# respawn pass the long-gone startup barrier by observing the param
-# stream, and the learner's silent_peers report clears on its first
-# chunk.  10 respawns/min cap guards against tight crash loops.
+# the role's join path (runtime/roles.py:_join_fleet, transport.barrier_wait
+# rejoin contract) lets the respawn pass the long-gone startup barrier by
+# observing the param stream, and the learner's silent_peers report clears
+# on its first chunk.  A child that keeps dying young (<60s uptime) stops
+# being respawned after 10 consecutive short-lived runs.
 idx=0
 while [ $idx -lt ${actors_per_node} ]; do
   ACTOR_ID=$(( ${node_id} * ${actors_per_node} + idx ))
   tmux new -s "actor-$ACTOR_ID" -d \
-    "fails=0; window=\$(date +%s); \
+    "fails=0; \
      while true; do \
+       start=\$(date +%s); \
        JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
        N_ENVS_PER_ACTOR=${envs_per_actor} \
        LEARNER_IP=${learner_ip} python -m apex_tpu.runtime \
        --env-id ${env_id} --barrier-timeout 1800; \
-       rc=\$?; now=\$(date +%s); \
-       if [ \$(( now - window )) -gt 60 ]; then fails=0; window=\$now; fi; \
+       rc=\$?; \
+       if [ \$(( \$(date +%s) - start )) -gt 60 ]; then fails=0; fi; \
        fails=\$(( fails + 1 )); \
        if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
        echo \"actor-$ACTOR_ID exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \

@@ -8,16 +8,17 @@ cd apex-tpu
 pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
 
 # Supervisor loop mirrors deploy/actor.sh: crashed evaluators respawn
-# (rejoining via the param stream once the startup barrier is gone),
-# capped at 10 respawns/min.
+# (rejoining via the param stream once the startup barrier is gone);
+# 10 consecutive short-lived (<60s) runs halt the respawns.
 tmux new -s evaluator -d \
-  "fails=0; window=\$(date +%s); \
+  "fails=0; \
    while true; do \
+     start=\$(date +%s); \
      JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
      --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
      --barrier-timeout 1800 --verbose; \
-     rc=\$?; now=\$(date +%s); \
-     if [ \$(( now - window )) -gt 60 ]; then fails=0; window=\$now; fi; \
+     rc=\$?; \
+     if [ \$(( \$(date +%s) - start )) -gt 60 ]; then fails=0; fi; \
      fails=\$(( fails + 1 )); \
      if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
      echo \"evaluator exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \

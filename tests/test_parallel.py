@@ -231,3 +231,34 @@ def test_sharded_is_weights_correct_under_skew(key):
     w_u = np.asarray(w_u)
     # global formula: every leaf equal -> every weight exactly 1
     np.testing.assert_allclose(w_u, 1.0, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_aql_trainer_on_virtual_mesh():
+    """AQLApexTrainer(mesh_shape=(8,)): the AQL family on the SAME sharded
+    plan as the DQN flagship — per-chip replay shards with a_mu candidate
+    sets, chunk aggregation, NoisyNet update keys split per chip, pmean'd
+    two-loss gradients — end to end with real actor processes."""
+    import dataclasses
+
+    from apex_tpu.config import small_test_config
+    from apex_tpu.training.aql import AQLApexTrainer
+
+    cfg = small_test_config(capacity=1024, batch_size=32, n_actors=2,
+                            env_id="ApexContinuousNav-v0")
+    cfg = cfg.replace(
+        learner=dataclasses.replace(cfg.learner, mesh_shape=(8,),
+                                    batch_size=32, ingest_chunk=32,
+                                    compute_dtype="float32"),
+        aql=dataclasses.replace(cfg.aql, propose_sample=8,
+                                uniform_sample=16))
+    t = AQLApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0)
+    assert t.n_dp == 8
+    t.train(total_steps=25, max_seconds=240)
+    assert t.steps_rate.total >= 25
+    assert t.ingested >= cfg.replay.warmup
+    sizes = np.asarray(t.replay_state.size)
+    assert sizes.shape == (8,) and (sizes > 0).all()
+    p = jax.tree.leaves(t.train_state.params)[0]
+    assert p.sharding.is_fully_replicated
+    assert np.isfinite(t.evaluate(episodes=1, max_steps=30))

@@ -208,7 +208,8 @@ def test_cli_parser_roles_and_env_twins(monkeypatch):
 @pytest.mark.slow
 def test_actor_rejoin_after_kill_clears_silent_peers():
     """The supervisor-respawn contract (deploy/actor.sh + roles.py
-    _rejoin_via_params): kill the only actor mid-run; the learner's
+    _join_fleet / transport.barrier_wait rejoin): kill the only actor
+    mid-run; the learner's
     silent_peers flags it; a respawned actor with the SAME identity
     rejoins PAST the long-gone startup barrier by observing the param
     stream, resumes shipping chunks, and silent_peers clears."""
@@ -292,4 +293,5 @@ def test_actor_rejoin_after_kill_clears_silent_peers():
             if p is not None:
                 p.terminate()
                 p.join(timeout=10)
-        done.wait(timeout=60)   # let train() unwind and pool.cleanup() run
+        trainer.request_stop()  # train() returns at its next iteration,
+        done.wait(timeout=60)   # unwinding pool.cleanup() (bound ports)
