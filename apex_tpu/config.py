@@ -152,7 +152,10 @@ class CommsConfig:
     max_outstanding_sends: int = 3   # actor credit window (actor.py:110-112)
     max_outstanding_prios: int = 16  # learner->replay window (learner.py:121-127)
     param_hwm: int = 3               # PUB high-water mark (learner.py:60)
-    n_recv_batch_procs: int = 4      # learner-side pullers (arguments.py:73-74)
+    # Learner-side decoder threads unpickling chunk payloads off the
+    # socket thread — the reference's N recv_batch pullers
+    # (learner.py:71-114, count arguments.py:73-74)
+    n_recv_batch_procs: int = 4
 
 
 @dataclass(frozen=True)

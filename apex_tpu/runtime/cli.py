@@ -218,7 +218,12 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
             from apex_tpu.training.aql import AQLTrainer as trainer_cls
             extra, train_kw = {}, dict(total_frames=args.total_frames)
         else:
-            from apex_tpu.training.apex import ApexTrainer as trainer_cls
+            if args.family == "aql":
+                from apex_tpu.training.aql import \
+                    AQLApexTrainer as trainer_cls
+            else:
+                from apex_tpu.training.apex import \
+                    ApexTrainer as trainer_cls
             extra = dict(train_ratio=args.train_ratio,
                          min_train_ratio=args.min_train_ratio)
             train_kw = dict(total_steps=args.total_steps,
@@ -234,6 +239,8 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
             raise SystemExit("--checkpoint required for enjoy")
         hook = None
         if args.render:
+            if args.render == "save" and not args.render_dir:
+                raise SystemExit("--render save requires --render-dir")
             from apex_tpu.utils.render import make_render_hook
             hook = make_render_hook(args.render, args.render_dir)
         score = evaluate_checkpoint(args.checkpoint,

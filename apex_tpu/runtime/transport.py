@@ -142,13 +142,28 @@ class ChunkSender:
 
 
 class ChunkReceiver:
-    """Learner-side ROUTER thread: receive, ack, enqueue.  Acks grant the
-    sender's next credit, so the bounded local queues backpressure the whole
-    fleet end-to-end (the reference got this from the replay server's recv
-    windows, ``replay.py:104-146``)."""
+    """Learner-side ROUTER + decode pipeline: the socket thread receives
+    and acks, ``n_decoders`` worker threads unpickle and enqueue.
+
+    Acks grant the sender's next credit, so the bounded local queues
+    backpressure the whole fleet end-to-end (the reference got this from
+    the replay server's recv windows, ``replay.py:104-146``).  The decoder
+    pool is the reference's N ``recv_batch`` pullers
+    (``learner.py:71-114``, count ``arguments.py:73-74``) re-shaped for
+    one process: deserialization moves OFF the socket thread so ack
+    latency — the credit grant pacing the whole actor fleet — never waits
+    behind a large pixel chunk's unpickle.  (Threads, not processes: the
+    win here is pipelining recv/ack with decode, not CPU parallelism —
+    the GIL bounds the latter, and the fused learner step, not decode
+    throughput, is the intended bottleneck.)
+
+    Backpressure chain: full ``chunks`` queue blocks decoders -> bounded
+    decode queue fills -> socket thread stops receiving and acking -> zmq
+    buffers -> sender credit windows exhaust -> actors block.  Exactly the
+    single-threaded behavior, with one queue more of slack."""
 
     def __init__(self, comms: CommsConfig, bind_ip: str = "*",
-                 queue_depth: int = 64):
+                 queue_depth: int = 64, n_decoders: int | None = None):
         self.sock = _ctx().socket(zmq.ROUTER)
         self.sock.bind(f"tcp://{bind_ip}:{comms.batch_port}")
         self.chunks: queue_lib.Queue = queue_lib.Queue(maxsize=queue_depth)
@@ -160,32 +175,67 @@ class ChunkReceiver:
         # false alarms under a silence threshold.
         self.last_seen: dict[str, float] = {}
         self._chunk_senders: set[str] = set()
-        # guards the two structures above: the receiver thread inserts
+        # guards the two structures above: receiver/decoder threads insert
         # while silent_peers() snapshots from the trainer thread
         self._peers_lock = threading.Lock()
         self._stop = threading.Event()
+        self.n_decoders = (n_decoders if n_decoders is not None
+                           else comms.n_recv_batch_procs)
+        self._decode_q: queue_lib.Queue = queue_lib.Queue(
+            maxsize=max(2 * self.n_decoders, 8))
+        self._ack_q: queue_lib.Queue = queue_lib.Queue()
         self._thread = threading.Thread(target=self._run, daemon=True)
+        self._decoders = [
+            threading.Thread(target=self._decode_loop, daemon=True)
+            for _ in range(self.n_decoders)]
 
     def start(self) -> None:
         self._thread.start()
+        for d in self._decoders:
+            d.start()
+
+    def _send_pending_acks(self) -> None:
+        try:
+            while True:
+                ident = self._ack_q.get_nowait()
+                self.sock.send_multipart([ident, b"ack"])
+        except queue_lib.Empty:
+            pass
 
     def _run(self) -> None:
+        """Socket thread: the only thread touching the ROUTER (zmq sockets
+        are not thread-safe) — receives frames, forwards raw payloads to
+        the decoders, sends the acks they enqueue."""
         while not self._stop.is_set():
+            self._send_pending_acks()
             if not self.sock.poll(100, zmq.POLLIN):
                 continue
             ident, payload = self.sock.recv_multipart()
-            name = ident.decode(errors="replace")
-            kind, body = pickle.loads(payload)
             with self._peers_lock:
-                self.last_seen[name] = time.monotonic()
-                if kind == "chunk":
-                    self._chunk_senders.add(name)
+                self.last_seen[ident.decode(errors="replace")] = \
+                    time.monotonic()
+            while not self._stop.is_set():
+                try:
+                    self._decode_q.put((ident, payload), timeout=0.1)
+                    break
+                except queue_lib.Full:     # decoders backed up: keep acks
+                    self._send_pending_acks()   # flowing for what's done
+
+    def _decode_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ident, payload = self._decode_q.get(timeout=0.1)
+            except queue_lib.Empty:
+                continue
+            kind, body = pickle.loads(payload)
             if kind == "chunk":
+                with self._peers_lock:
+                    self._chunk_senders.add(ident.decode(errors="replace"))
                 # enqueue BEFORE acking: the ack is the credit grant
                 while not self._stop.is_set():
                     try:
                         self.chunks.put(body, timeout=0.1)
-                        self.sock.send_multipart([ident, b"ack"])
+                        self._ack_q.put(ident)
                         break
                     except queue_lib.Full:
                         continue
@@ -199,6 +249,9 @@ class ChunkReceiver:
         self._stop.set()
         if self._thread.ident is not None:   # tolerate never-started
             self._thread.join(timeout=5)
+        for d in self._decoders:
+            if d.ident is not None:
+                d.join(timeout=5)
         self.sock.close(linger=0)
 
 

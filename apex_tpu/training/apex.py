@@ -101,6 +101,44 @@ class ConcurrentTrainer(CheckpointableTrainer):
             self._stop_requested = threading.Event()
         self._stop_requested.set()
 
+    # -- multi-chip plan (shared by both families) ------------------------
+
+    def _init_sharded(self) -> None:
+        """dp > 1: shard the replay per chip, pmean grads over ICI,
+        round-robin whole chunks across shards (BASELINE.json north star:
+        HBM replay + 8-chip learner).  Total replay capacity = per-chip
+        capacity x dp.  Requires ``self.core``/``self.replay_state``/
+        ``self.train_state``/``self.pool`` already built single-shard;
+        AQL's NoisyNet update key is handled by ``ShardedLearner`` via
+        ``core.update_needs_key``."""
+        from apex_tpu.parallel.aggregate import ChunkAggregator
+        from apex_tpu.parallel.learner import ShardedLearner
+        from apex_tpu.parallel.mesh import make_mesh
+
+        n = self.n_dp
+        devices = jax.devices()
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
+                f"devices, have {len(devices)}")
+        mesh = make_mesh(dp=n, devices=devices[:n])
+        sl = self.sharded = ShardedLearner(self.core, mesh)
+        self.replay_state = sl.shard_replay_state(self.replay_state)
+        self.train_state = sl.replicate_train_state(self.train_state)
+        self.pool = ChunkAggregator(self.pool, n)
+
+        fused = sl.make_fused_step()
+        train = sl.make_train_step()
+        ingest = sl.make_ingest()
+
+        def _fused(ts, rs, payload, prios, key, beta):
+            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
+
+        def _train(ts, rs, key, beta):
+            return train(ts, rs, sl.device_keys(key), beta)
+
+        self._fused, self._train, self._ingest = _fused, _train, ingest
+
     # -- main loop ---------------------------------------------------------
 
     def train(self, total_steps: int, max_seconds: float = 3600.0,
@@ -110,6 +148,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
         checkpoint — same resume contract as the single-process drivers."""
         cfg = self.cfg
         pool = self.pool
+        if self._stop_requested is not None:   # a fresh call starts fresh:
+            self._stop_requested.clear()       # request_stop is per-run
         target_steps = self.steps_rate.total + total_steps
         pool.start()
         try:
@@ -328,10 +368,10 @@ class ApexTrainer(ConcurrentTrainer):
                                   shm_slot_bytes=slot)
 
         self.n_dp = int(np.prod(lc.mesh_shape))
+        self.replay_state = self.replay.init()
         if self.n_dp > 1:
             self._init_sharded()
         else:
-            self.replay_state = self.replay.init()
             self._fused = self.core.jit_fused_step()
             self._train = self.core.jit_train_step()
             self._ingest = self.core.jit_ingest()
@@ -344,38 +384,7 @@ class ApexTrainer(ConcurrentTrainer):
         self.checkpointer = (Checkpointer(checkpoint_dir)
                              if checkpoint_dir else None)
 
-    def _init_sharded(self) -> None:
-        """dp > 1: shard the frame-pool replay per chip, pmean grads over
-        ICI, round-robin whole chunks across shards (BASELINE.json north
-        star: HBM replay + 8-chip learner).  Total replay capacity =
-        per-chip capacity x dp."""
-        from apex_tpu.parallel.aggregate import ChunkAggregator
-        from apex_tpu.parallel.learner import ShardedLearner
-        from apex_tpu.parallel.mesh import make_mesh
-
-        n = self.n_dp
-        devices = jax.devices()
-        if len(devices) < n:
-            raise ValueError(
-                f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
-                f"devices, have {len(devices)}")
-        mesh = make_mesh(dp=n, devices=devices[:n])
-        sl = self.sharded = ShardedLearner(self.core, mesh)
-        self.replay_state = sl.init_replay(None)
-        self.train_state = sl.replicate_train_state(self.train_state)
-        self.pool = ChunkAggregator(self.pool, n)
-
-        fused = sl.make_fused_step()
-        train = sl.make_train_step()
-        ingest = sl.make_ingest()
-
-        def _fused(ts, rs, payload, prios, key, beta):
-            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
-
-        def _train(ts, rs, key, beta):
-            return train(ts, rs, sl.device_keys(key), beta)
-
-        self._fused, self._train, self._ingest = _fused, _train, ingest
+    # _init_sharded: ConcurrentTrainer (shared with the AQL family)
 
     # -- evaluation --------------------------------------------------------
 

@@ -556,39 +556,7 @@ class AQLApexTrainer(ConcurrentTrainer):
         self.checkpointer = (Checkpointer(checkpoint_dir)
                              if checkpoint_dir else None)
 
-    def _init_sharded(self) -> None:
-        """dp > 1: shard the AQL replay per chip (ShardedLearner splits the
-        per-chip key between sampling and the NoisyNet update via
-        ``AQLCore.update_needs_key``), pmean grads over ICI, round-robin
-        whole chunks across shards — the same plan as the DQN flagship
-        (``ApexTrainer._init_sharded``)."""
-        from apex_tpu.parallel.aggregate import ChunkAggregator
-        from apex_tpu.parallel.learner import ShardedLearner
-        from apex_tpu.parallel.mesh import make_mesh
-
-        n = self.n_dp
-        devices = jax.devices()
-        if len(devices) < n:
-            raise ValueError(
-                f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
-                f"devices, have {len(devices)}")
-        mesh = make_mesh(dp=n, devices=devices[:n])
-        sl = self.sharded = ShardedLearner(self.core, mesh)
-        self.replay_state = sl.shard_replay_state(self.replay_state)
-        self.train_state = sl.replicate_train_state(self.train_state)
-        self.pool = ChunkAggregator(self.pool, n)
-
-        fused = sl.make_fused_step()
-        train = sl.make_train_step()
-        ingest = sl.make_ingest()
-
-        def _fused(ts, rs, payload, prios, key, beta):
-            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
-
-        def _train(ts, rs, key, beta):
-            return train(ts, rs, sl.device_keys(key), beta)
-
-        self._fused, self._train, self._ingest = _fused, _train, ingest
+    # _init_sharded: ConcurrentTrainer (one multi-chip plan, both families)
 
     def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
                  max_steps: int = 1000) -> float:

@@ -69,7 +69,7 @@ E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 90.0))
 # stage deadlines (watchdog): generous but finite — the whole bench must
 # land inside the driver's outer timeout with the JSON line printed
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 240.0))
-PART1_TIMEOUT = float(os.environ.get("BENCH_PART1_TIMEOUT", 420.0))
+PART1_TIMEOUT = float(os.environ.get("BENCH_PART1_TIMEOUT", 360.0))
 PART2_TIMEOUT = E2E_SECONDS + float(
     os.environ.get("BENCH_PART2_MARGIN", 240.0))
 
@@ -318,7 +318,7 @@ def main() -> None:
         RESULT["platform"] = platform
 
     if platform == "tpu":
-        _arm("pallas_probe", 300)
+        _arm("pallas_probe", 240)
         err = probe_pallas()
         if err is not None:
             with _print_lock:

@@ -260,9 +260,13 @@ def test_actor_rejoin_after_kill_clears_silent_peers():
                 time_mod.sleep(0.25)
             pytest.fail(f"timed out waiting for {what}")
 
-        # phase 1: the actor joined and ships chunks
+        # phase 1: the actor joined and ships chunks.  Liveness is WAITED
+        # for, not asserted instantly: during the first ingest compile the
+        # bounded queues fill and the socket thread stops receiving, so
+        # last_seen can legitimately be seconds stale at this moment.
         wait_for(lambda: trainer.ingested > 0, 60, "first chunks")
-        assert pool.silent_peers(threshold_s=5.0) == []
+        wait_for(lambda: pool.silent_peers(threshold_s=5.0) == [], 30,
+                 "initial liveness")
 
         # phase 2: SIGKILL the actor; it goes silent
         actor.kill()
@@ -295,3 +299,113 @@ def test_actor_rejoin_after_kill_clears_silent_peers():
                 p.join(timeout=10)
         trainer.request_stop()  # train() returns at its next iteration,
         done.wait(timeout=60)   # unwinding pool.cleanup() (bound ports)
+
+
+def _aql_actor_main(cfg, actor_id, n_actors):
+    from apex_tpu.runtime.roles import run_actor
+    run_actor(cfg, RoleIdentity(role="actor", actor_id=actor_id,
+                                n_actors=n_actors), family="aql",
+              barrier_timeout_s=60)
+
+
+@pytest.mark.slow
+def test_localhost_aql_topology():
+    """The AQL family over real TCP (C13/C14 for the second model family):
+    AQL actor processes ship a_mu-carrying chunks to the socket learner,
+    which trains the fused two-loss step and publishes back."""
+    n_actors = 2
+    cfg = _test_config(n_actors)
+    cfg = cfg.replace(
+        env=dataclasses.replace(cfg.env, env_id="ApexContinuousNav-v0"),
+        aql=dataclasses.replace(cfg.aql, propose_sample=8,
+                                uniform_sample=16))
+    ctx = mp.get_context("spawn")
+
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    procs = []
+    try:
+        for i in range(n_actors):
+            procs.append(ctx.Process(target=_aql_actor_main,
+                                     args=(cfg, i, n_actors), daemon=True))
+        for p in procs:
+            p.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    from apex_tpu.runtime.roles import run_learner
+    try:
+        trainer = run_learner(cfg, n_peers=n_actors, total_steps=30,
+                              max_seconds=180, family="aql",
+                              barrier_timeout_s=60, train_ratio=8.0)
+        assert trainer.steps_rate.total >= 30
+        assert trainer.ingested >= cfg.replay.warmup
+        assert trainer.param_version >= 2
+        assert trainer.log.history.get("learner/episode_reward")
+        assert np.isfinite(trainer.evaluate(episodes=1, max_steps=40))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=10)
+
+
+def test_chunk_receiver_decode_pipeline_credits_flow():
+    """The decoder-pool receiver (reference learner.py:71-114's N pullers):
+    with a credit window of 3, a sender can only complete >3 sends if acks
+    flow back through the decode pipeline; chunks and stats all arrive
+    intact across 4 decoder threads."""
+    import threading as th
+
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    cfg = _test_config(1)
+    recv = ChunkReceiver(cfg.comms, queue_depth=64, n_decoders=4)
+    assert len(recv._decoders) == 4
+    recv.start()
+    n_chunks, senders = 12, 2
+    try:
+        def sender_body(sid):
+            s = ChunkSender(cfg.comms, f"actor-{sid}")
+            try:
+                for i in range(n_chunks):
+                    assert s.send_chunk({"sid": sid, "i": i,
+                                         "blob": b"x" * 50_000})
+                    s.send_stat({"sid": sid, "ep": i})
+            finally:
+                s.close()
+
+        threads = [th.Thread(target=sender_body, args=(sid,))
+                   for sid in range(senders)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "sender wedged: credits not flowing"
+
+        got = []
+        deadline = 20.0
+        import time as time_mod
+        end = time_mod.monotonic() + deadline
+        while len(got) < senders * n_chunks and time_mod.monotonic() < end:
+            try:
+                got.append(recv.chunks.get(timeout=0.5))
+            except Exception:
+                pass
+        assert len(got) == senders * n_chunks
+        # per-sender arrival order is preserved enough to recover every
+        # message exactly once
+        per = {sid: sorted(m["i"] for m in got if m["sid"] == sid)
+               for sid in range(senders)}
+        for sid in range(senders):
+            assert per[sid] == list(range(n_chunks))
+        with recv._peers_lock:
+            assert recv._chunk_senders == {"actor-0", "actor-1"}
+    finally:
+        recv.stop()
