@@ -184,6 +184,12 @@ class ChunkReceiver:
         self._decode_q: queue_lib.Queue = queue_lib.Queue(
             maxsize=max(2 * self.n_decoders, 8))
         self._ack_q: queue_lib.Queue = queue_lib.Queue()
+        # messages handed to decoders and not yet acked/filed: while any
+        # are in flight the socket loop polls on a short timeout so a
+        # just-enqueued ack (the sender's next credit) leaves within ~5ms
+        # instead of waiting out a full idle poll
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._decoders = [
             threading.Thread(target=self._decode_loop, daemon=True)
@@ -208,7 +214,9 @@ class ChunkReceiver:
         the decoders, sends the acks they enqueue."""
         while not self._stop.is_set():
             self._send_pending_acks()
-            if not self.sock.poll(100, zmq.POLLIN):
+            with self._inflight_lock:
+                busy = self._inflight > 0
+            if not self.sock.poll(5 if busy else 100, zmq.POLLIN):
                 continue
             ident, payload = self.sock.recv_multipart()
             with self._peers_lock:
@@ -217,6 +225,8 @@ class ChunkReceiver:
             while not self._stop.is_set():
                 try:
                     self._decode_q.put((ident, payload), timeout=0.1)
+                    with self._inflight_lock:
+                        self._inflight += 1
                     break
                 except queue_lib.Full:     # decoders backed up: keep acks
                     self._send_pending_acks()   # flowing for what's done
@@ -227,23 +237,28 @@ class ChunkReceiver:
                 ident, payload = self._decode_q.get(timeout=0.1)
             except queue_lib.Empty:
                 continue
-            kind, body = pickle.loads(payload)
-            if kind == "chunk":
-                with self._peers_lock:
-                    self._chunk_senders.add(ident.decode(errors="replace"))
-                # enqueue BEFORE acking: the ack is the credit grant
-                while not self._stop.is_set():
+            try:
+                kind, body = pickle.loads(payload)
+                if kind == "chunk":
+                    with self._peers_lock:
+                        self._chunk_senders.add(
+                            ident.decode(errors="replace"))
+                    # enqueue BEFORE acking: the ack is the credit grant
+                    while not self._stop.is_set():
+                        try:
+                            self.chunks.put(body, timeout=0.1)
+                            self._ack_q.put(ident)
+                            break
+                        except queue_lib.Full:
+                            continue
+                elif kind == "stat":
                     try:
-                        self.chunks.put(body, timeout=0.1)
-                        self._ack_q.put(ident)
-                        break
+                        self.stats.put_nowait(body)
                     except queue_lib.Full:
-                        continue
-            elif kind == "stat":
-                try:
-                    self.stats.put_nowait(body)
-                except queue_lib.Full:
-                    pass
+                        pass
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
 
     def stop(self) -> None:
         self._stop.set()

@@ -148,8 +148,6 @@ class ConcurrentTrainer(CheckpointableTrainer):
         checkpoint — same resume contract as the single-process drivers."""
         cfg = self.cfg
         pool = self.pool
-        if self._stop_requested is not None:   # a fresh call starts fresh:
-            self._stop_requested.clear()       # request_stop is per-run
         target_steps = self.steps_rate.total + total_steps
         pool.start()
         try:
@@ -272,6 +270,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     last_log = steps
         finally:
             pool.cleanup()
+            stop = self._stop_requested
+            if stop is not None:
+                # honored (or stale) requests clear at EXIT, never at
+                # entry: a request racing train() startup must still stop
+                # this run; the NEXT call then starts fresh
+                stop.clear()
         return self
 
     def _beta(self) -> float:

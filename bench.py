@@ -63,9 +63,9 @@ WARMUP_STEPS = 3
 MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 50))
 REPS = int(os.environ.get("BENCH_REPS", 3))
 # first TPU compile of the concurrent pipeline eats ~20-40s of this wall
-# budget; the steady-state window after it is what the sliding rate
-# counters report
-E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 90.0))
+# budget and the 2048-transition warmup a further slice; the steady-state
+# window after both is what the sliding rate counters report
+E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 120.0))
 # stage deadlines (watchdog): generous but finite — the whole bench must
 # land inside the driver's outer timeout with the JSON line printed
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 240.0))
@@ -312,10 +312,20 @@ def bench_end_to_end() -> dict:
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
 
+    global MEASURE_STEPS, REPS
     _arm("backend_probe", INIT_TIMEOUT + 60)
     platform = probe_backend()
     with _print_lock:
         RESULT["platform"] = platform
+    if platform != "tpu":
+        # CPU fallback at full batch/capacity is ~100x slower per step:
+        # shrink the measurement loop so the diagnostic number still lands
+        # inside the part-1 budget instead of tripping the watchdog
+        # (explicit env overrides are honored)
+        if "BENCH_STEPS" not in os.environ:
+            MEASURE_STEPS = min(MEASURE_STEPS, 10)
+        if "BENCH_REPS" not in os.environ:
+            REPS = min(REPS, 2)
 
     if platform == "tpu":
         _arm("pallas_probe", 240)

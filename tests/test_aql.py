@@ -382,3 +382,30 @@ def test_aql_pixel_frame_pool_pipeline():
     assert float(np.abs(np.asarray(t.replay_state.extras["a_mu"])).max()) > 0
     assert all(not p.is_alive() for p in t.pool.procs)
     assert np.isfinite(t.evaluate(episodes=1, max_steps=60))
+
+
+@pytest.mark.slow
+def test_aql_pixel_vector_actors():
+    """VectorAQLPixelWorkerFamily: one process x 3 env slots of 84x84
+    Catch act through ONE batched propose+score call, per-slot chunk
+    builders shipping a_mu sidecars into the frame-pool learner."""
+    import dataclasses as dc
+
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+    from apex_tpu.training.aql import AQLApexTrainer
+
+    cfg = small_test_config(capacity=2048, batch_size=16, n_actors=1,
+                            env_id="ApexCatch-v0")
+    cfg = cfg.replace(
+        env=dc.replace(cfg.env, frame_stack=2),
+        replay=dc.replace(cfg.replay, warmup=128),
+        actor=dc.replace(cfg.actor, n_envs_per_actor=3),
+        aql=dc.replace(cfg.aql, propose_sample=8, uniform_sample=16))
+    t = AQLApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0)
+    assert isinstance(t.replay, FramePoolReplay)
+    t.train(total_steps=8, max_seconds=240)
+    assert t.steps_rate.total >= 8
+    # stats carry global slot ids from the vector lanes
+    slots = {int(v) for _, v in t.log.history.get("learner/actor_id", [])}
+    assert slots and max(slots) >= 1, f"vector slots missing: {slots}"
+    assert all(not p.is_alive() for p in t.pool.procs)

@@ -230,3 +230,41 @@ def test_enjoy_render_hooks(tmp_path):
     assert len(files) == 2
     stack = np.load(files[0])
     assert stack.ndim == 4 and stack.shape[1:] == (42, 42, 1)
+
+
+@pytest.mark.slow
+def test_pixel_aql_frame_pool_checkpoint_roundtrip(tmp_path):
+    """The frame-pool AQL bundle (frames ring + a_mu sidecar dict in
+    FramePoolState.extras) must save and restore bit-exactly like every
+    other layout."""
+    import dataclasses as dc
+
+    from apex_tpu.training.aql import AQLApexTrainer
+
+    cfg = small_test_config(capacity=1024, batch_size=16, n_actors=1,
+                            env_id="ApexCatchSmall-v0")
+    cfg = cfg.replace(
+        env=dc.replace(cfg.env, frame_stack=2),
+        replay=dc.replace(cfg.replay, warmup=64),
+        aql=dc.replace(cfg.aql, propose_sample=6, uniform_sample=3))
+    t1 = AQLApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0,
+                        checkpoint_dir=str(tmp_path))
+    t1.train(total_steps=5, max_seconds=180)
+    assert t1.steps_rate.total >= 5
+    path = t1.save_checkpoint()
+
+    t2 = AQLApexTrainer(cfg, publish_min_seconds=0.05,
+                        checkpoint_dir=str(tmp_path))
+    t2.restore(path)
+    assert t2.steps_rate.total == t1.steps_rate.total
+    assert t2.ingested == t1.ingested
+    for a, b in zip(jax.tree.leaves(t1.replay_state),
+                    jax.tree.leaves(t2.replay_state), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(t1.train_state.params),
+                    jax.tree.leaves(t2.train_state.params), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the sidecar dict specifically survived
+    np.testing.assert_array_equal(
+        np.asarray(t1.replay_state.extras["a_mu"]),
+        np.asarray(t2.replay_state.extras["a_mu"]))
