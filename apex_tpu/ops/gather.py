@@ -5,24 +5,36 @@ reconstruction (:meth:`apex_tpu.replay.frame_pool.FramePoolReplay.sample`):
 ``2 * B * S`` random rows of the HBM frame ring — for the reference config
 (B=512, S=4, 84x84 frames) ~29MB of data-dependent gather per step.  XLA
 lowers ``frames[ids]`` to a generic dynamic-gather; this kernel instead
-streams each row with an explicit double-buffered DMA driven by
-scalar-prefetched indices (the embedding-lookup pattern from the pallas
-guide): the row ids land in SMEM before the kernel body runs, so every
-grid step issues its next row fetch while the previous one is in flight,
-and the row bytes move HBM -> VMEM exactly once.
+streams rows through Mosaic's own grid pipeline driven by scalar-prefetched
+indices (the embedding-lookup pattern from the pallas guide): the row ids
+land in SMEM before the kernel body runs, the input BlockSpec's
+``index_map`` reads ``ids[i]`` to pick each grid step's source row, and
+Mosaic double-buffers the row DMAs — fetching step ``i+1``'s row while
+step ``i`` writes back.
 
-The kernel is TPU-only; :func:`gather_rows` dispatches on the platform of
-the ``frames`` buffer — ``jnp.take`` everywhere else (CPU CI, the virtual
-mesh) — and parity is pinned by ``tests/test_gather.py`` in interpret mode.
+History: the first version of this kernel hand-rolled the DMAs
+(``make_async_copy`` with a per-row semaphore array).  It passed interpret
+parity and a round-3 standalone on-chip run, then on the round-4 live chip
+it HUNG — and an orphaned on-device DMA wait wedges the device for every
+subsequent client, which is the worst failure mode a replay-path op can
+have.  This rewrite delegates all DMA scheduling/semaphores to Mosaic's
+pipeline machinery precisely to remove that class of deadlock; the
+hand-rolled grouping is gone until the simple form is proven on hardware.
+
+The kernel is TPU-only and strictly OPT-IN until it has a clean on-chip
+record (see :func:`resolved_mode`): ``gather_mode="pallas"`` on the replay
+spec, or the process-global ``APEX_GATHER_MODE=pallas`` — which still
+gates per-operand on layout eligibility.  Everything else (CPU CI, the
+virtual mesh, un-opted TPU runs) takes ``jnp.take``; parity is pinned by
+``tests/test_gather.py`` in interpret mode.
 
 Mosaic constrains DMA slices of 2-D buffers to (8, 128)-tile boundaries, so
 single-row slices of ``[F, D]`` only lower when each row is itself a whole
 number of tiles: rows must span a multiple of ``ROW_UNIT = 8 * 128``
 elements.  :class:`~apex_tpu.replay.frame_pool.FramePoolReplay` pads its
 ring rows to this unit for pixel frames (84x84 -> 7168, +1.6%); the kernel
-then views the ring as ``[F, 8, D/8]`` and slices dim 0, which carries no
-tiling constraint.  Ineligible layouts (tiny vector obs, odd dtypes) fall
-back to ``jnp.take`` in auto mode.
+then views the ring as ``[F, 8, D/8]`` and blocks dim 0, which carries no
+tiling constraint.
 
 Reference analogue: the torch side pays this cost in
 ``_encode_sample``'s host-side ``np.stack`` of LazyFrames
@@ -45,32 +57,14 @@ from jax.experimental.pallas import tpu as pltpu
 # one (8, 128) tile, in elements: the row-size quantum the kernel needs
 ROW_UNIT = 8 * 128
 
-# rows DMA'd per grid step (row count padded up to a multiple): enough
-# in-flight transfers to amortize per-row DMA latency; the VMEM out block
-# stays small (32 * 7168B = 229KB for Atari rows)
-_GROUP = 32
 
-
-def _gather_kernel(ids_ref, frames_ref, out_ref, sems):
-    """One grid step DMAs _GROUP rows HBM->VMEM: start all, then drain, so
-    the row-fetch latencies overlap each other, and Mosaic's grid pipeline
-    overlaps this step's fetches with the previous block's writeback.
-    Refs are 3-D ``[rows, 8, D/8]`` — the sliced dim sits outside the
-    (8, 128)-tiled trailing pair, so single-row slices lower cleanly
-    (slicing a 2-D ``[F, D]`` ref one row at a time does not: Mosaic
-    requires tile-aligned slices in the trailing two dims)."""
-    i = pl.program_id(0)
-    copies = []
-    for j in range(_GROUP):
-        row = ids_ref[i * _GROUP + j]
-        cp = pltpu.make_async_copy(
-            frames_ref.at[pl.ds(row, 1)],
-            out_ref.at[pl.ds(j, 1)],
-            sems.at[j])
-        cp.start()
-        copies.append(cp)
-    for cp in copies:
-        cp.wait()
+def _gather_kernel(ids_ref, in_ref, out_ref):
+    """Per grid step: one gathered row, already staged into VMEM by the
+    pipeline (the in_spec's index_map chose the source row from the
+    prefetched ids).  The body is a plain VMEM copy; all DMA issue/wait
+    is Mosaic's."""
+    del ids_ref
+    out_ref[...] = in_ref[...]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -80,32 +74,20 @@ def _pallas_gather(frames3: jax.Array, ids: jax.Array,
     reshaping a 2-D ring inside the same jit makes XLA materialize a copy
     of the whole ring as the custom-call operand, which costs more than the
     gather itself.  FramePoolReplay therefore STORES its ring 3-D."""
-    n, (f, _, c) = ids.shape[0], frames3.shape
-    pad = (-n) % _GROUP
-    ids_padded = jnp.pad(ids, (0, pad))         # extra rows cut off below
-    grid = (ids_padded.shape[0] // _GROUP,)
+    n, c = ids.shape[0], frames3.shape[2]
     out = pl.pallas_call(
         _gather_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # ring in HBM
-            out_specs=pl.BlockSpec((_GROUP, 8, c),
-                                   lambda i, ids: (i, 0, 0)),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((_GROUP,))],
+            grid=(n,),
+            in_specs=[pl.BlockSpec((1, 8, c),
+                                   lambda i, ids: (ids[i], 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, c), lambda i, ids: (i, 0, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((ids_padded.shape[0], 8, c),
-                                       frames3.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, 8, c), frames3.dtype),
         interpret=interpret,
-    )(ids_padded, frames3)
-    return out.reshape(-1, 8 * c)[:n]
-
-
-def _on_tpu(x: jax.Array) -> bool:
-    try:
-        return list(x.devices())[0].platform == "tpu"
-    except Exception:        # tracers under jit: ask the default backend
-        return jax.default_backend() == "tpu"
+    )(ids, frames3)
+    return out.reshape(n, 8 * c)
 
 
 def pallas_eligible(d: int, dtype) -> bool:
@@ -119,7 +101,16 @@ def resolved_mode(frames: jax.Array, mode: str = "auto") -> str:
     """The concrete path :func:`gather_rows` will take for this operand —
     ``pallas`` | ``interpret`` | ``xla`` — with the ``APEX_GATHER_MODE``
     operational override applied.  Benches report this so a silent
-    fallback is visible in the recorded JSON."""
+    fallback is visible in the recorded JSON.
+
+    ``auto`` currently resolves to ``xla`` EVERYWHERE, including eligible
+    TPU layouts: the round-4 live run proved a misbehaving gather kernel
+    doesn't just fail, it can wedge the whole device for every later
+    client (module docstring).  Until the rewritten kernel has a clean
+    on-chip record, the kernel path is strictly opt-in —
+    ``APEX_GATHER_MODE=pallas`` or an explicit ``gather_mode="pallas"`` —
+    and ``bench.py`` attempts that opt-in LAST, after the safe numbers
+    are recorded."""
     if mode != "auto":
         return mode
     forced = os.environ.get("APEX_GATHER_MODE")
@@ -128,10 +119,18 @@ def resolved_mode(frames: jax.Array, mode: str = "auto") -> str:
             raise ValueError(
                 f"APEX_GATHER_MODE={forced!r}: expected pallas | "
                 f"interpret | xla | auto")
+        if forced in ("pallas", "interpret"):
+            # the env opt-in is process-GLOBAL but eligibility is
+            # per-OPERAND: a process can hold both an eligible pixel ring
+            # (stored 3-D) and a small vector pool (2-D, rows not whole
+            # tiles) — the latter must quietly keep the XLA path rather
+            # than hand Mosaic an unsliceable layout (interpret gets the
+            # same gate so a CPU parity lane behaves like the chip would)
+            d = math.prod(frames.shape[1:])
+            if not (frames.ndim == 3 and pallas_eligible(d, frames.dtype)):
+                return "xla"
         return forced
-    d = math.prod(frames.shape[1:])
-    return ("pallas" if frames.ndim == 3 and _on_tpu(frames)
-            and pallas_eligible(d, frames.dtype) else "xla")
+    return "xla"
 
 
 def gather_rows(frames: jax.Array, ids: jax.Array,
@@ -140,9 +139,10 @@ def gather_rows(frames: jax.Array, ids: jax.Array,
 
     ``frames`` is either the flat ring ``[F, D]`` or the tiled 3-D view
     ``[F, 8, D/8]`` the pallas kernel needs (what FramePoolReplay stores
-    for pixel frames).  mode: ``auto`` = pallas kernel on TPU for tiled
-    eligible rings, ``jnp.take`` elsewhere; ``pallas`` / ``interpret`` /
-    ``xla`` force a path (tests, benches).
+    for pixel frames).  mode: ``auto`` currently resolves to ``jnp.take``
+    everywhere unless ``APEX_GATHER_MODE`` overrides (see
+    :func:`resolved_mode` for why); ``pallas`` / ``interpret`` / ``xla``
+    force a path (tests, benches, opted-in production).
     """
     d = math.prod(frames.shape[1:])
     mode = resolved_mode(frames, mode)

@@ -137,7 +137,21 @@ class ChunkSender:
         except zmq.Again:
             pass
 
-    def close(self) -> None:
+    def close(self, drain_s: float = 2.0) -> None:
+        """Drain outstanding acks (up to ``drain_s``) before closing.
+
+        ``linger=0`` discards queued-but-unflushed messages, and with a
+        credit window of W up to W just-sent chunks can still sit in the
+        zmq send buffer when the actor shuts down — they would vanish
+        silently (observed as a flaky all-roles test under CPU load).  An
+        ack is proof the learner has received AND filed the chunk, so
+        waiting for the window to empty makes clean shutdown lossless;
+        on timeout (learner already dead) the remaining chunks are
+        dropped, which is also what the reference's teardown does
+        (``actor.py:110-114`` has no flush protocol at all)."""
+        deadline = time.monotonic() + drain_s
+        while self._in_flight > 0 and time.monotonic() < deadline:
+            self._drain_acks(50)
         self.sock.close(linger=0)
 
 

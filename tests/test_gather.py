@@ -122,6 +122,24 @@ def test_padded_ring_roundtrips_through_sample():
     np.testing.assert_array_equal(got, want)
 
 
+def test_env_pallas_optin_gates_per_operand(monkeypatch):
+    """APEX_GATHER_MODE=pallas is process-global, but eligibility is
+    per-operand: an eligible tiled 3-D ring resolves to the kernel while
+    a small 2-D vector ring quietly keeps the XLA path (it would hand
+    Mosaic an unsliceable layout otherwise)."""
+    from apex_tpu.ops.gather import ROW_UNIT, resolved_mode
+
+    monkeypatch.setenv("APEX_GATHER_MODE", "pallas")
+    eligible = jnp.zeros((16, 8, ROW_UNIT // 8), jnp.uint8)
+    vector = jnp.zeros((16, 8), jnp.float32)
+    assert resolved_mode(eligible) == "pallas"
+    assert resolved_mode(vector) == "xla"
+    monkeypatch.setenv("APEX_GATHER_MODE", "xla")
+    assert resolved_mode(eligible) == "xla"
+    monkeypatch.delenv("APEX_GATHER_MODE")
+    assert resolved_mode(eligible) == "xla"    # opt-in only
+
+
 def test_auto_mode_uses_xla_off_tpu():
     """On the CPU CI platform auto must route to jnp.take (the kernel is
     TPU-only); the call must still be correct under jit."""
