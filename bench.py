@@ -20,9 +20,15 @@ resident in HBM (~7.5GB/chip); an 8-chip slice with per-chip shards doubles
 the reference's 2e6 total capacity.  Stacks are gathered on device at
 sample time.
 
+Part 1 measures two dispatch shapes: one fused step per host round-trip
+("single") and a ``lax.scan`` of BENCH_SCAN=8 bit-identical steps per
+round-trip ("scanK" — host dispatch is the dominant per-step overhead on
+relay-backed chips); the headline takes the faster, with both recorded.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
 "spread" (min/max over reps), "mfu", "gather" (the row-gather path actually
-used), "platform", and "e2e" (the ApexTrainer rates).
+used), "dispatch" ("single" | "scanK"), "platform", and "e2e" (the
+ApexTrainer rates).
 vs_baseline = value / 11.0 (midpoint of the reference's 10-12 range).
 
 Hang hardening (round 3 lost its only on-chip number to a silent 25-minute
@@ -286,10 +292,47 @@ def bench_fused_step() -> dict:
     peak = (float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
             if "BENCH_PEAK_TFLOPS" in os.environ else DEFAULT_PEAK)
     util = mfu(flops, float(np.median(rates)), peak)
-    return {"median": float(np.median(rates)),
-            "min": round(min(rates), 2), "max": round(max(rates), 2),
-            "reps": REPS, "gather": gather,
-            "mfu": None if util is None else round(util, 4)}
+    out = {"median": float(np.median(rates)),
+           "min": round(min(rates), 2), "max": round(max(rates), 2),
+           "reps": REPS, "gather": gather,
+           "mfu": None if util is None else round(util, 4)}
+
+    # scan-of-K dispatch: same per-step program (tests pin bit-parity),
+    # K fewer host round-trips — the dominant overhead on relay-backed
+    # chips.  Reported per-STEP so the unit stays comparable.  main()
+    # zeroes BENCH_SCAN on non-TPU platforms: XLA:CPU lowers the conv
+    # backward ~20x slower inside while-loops (measured), so a CPU scan
+    # number is a backend artifact, not a signal.
+    k = int(os.environ.get("BENCH_SCAN", 8))
+    if k > 1:
+        multi = core.jit_fused_multi_step()
+        stacked = jax.device_put(jax.tree.map(
+            lambda x: jnp.stack([jnp.asarray(x)] * k), chunk))
+        sprios = jax.device_put(jnp.stack([jnp.asarray(prios)] * k))
+        n_dispatch = max(1, MEASURE_STEPS // k)
+        keys = jax.random.split(jax.random.key(7), k)
+        ts, rs, m = multi(ts, rs, stacked, sprios, keys, jnp.float32(0.4))
+        jax.block_until_ready(m["loss"])              # compile + warm
+        scan_rates = []
+        for rep in range(REPS):
+            t0 = time.perf_counter()
+            for i in range(n_dispatch):
+                keys = jax.random.split(
+                    jax.random.key(5000 + 1000 * rep + i), k)
+                ts, rs, m = multi(ts, rs, stacked, sprios, keys,
+                                  jnp.float32(0.4))
+            jax.block_until_ready(m["loss"])
+            scan_rates.append(n_dispatch * k
+                              / (time.perf_counter() - t0))
+        sflops = flops_per_call(multi, ts, rs, stacked, sprios, keys,
+                                jnp.float32(0.4))
+        sutil = mfu(None if sflops is None else sflops / k,
+                    float(np.median(scan_rates)), peak)
+        out["scan"] = {"k": k, "median": float(np.median(scan_rates)),
+                       "min": round(min(scan_rates), 2),
+                       "max": round(max(scan_rates), 2),
+                       "mfu": None if sutil is None else round(sutil, 4)}
+    return out
 
 
 # -- part 2: end-to-end pixel pipeline -------------------------------------
@@ -359,6 +402,11 @@ def main() -> None:
             MEASURE_STEPS = min(MEASURE_STEPS, 10)
         if "BENCH_REPS" not in os.environ:
             REPS = min(REPS, 2)
+        if "BENCH_SCAN" not in os.environ:
+            # scan dispatch is a TPU measurement; on XLA:CPU the conv
+            # backward degrades ~20x inside while-loops (backend
+            # artifact) and would burn minutes producing noise
+            os.environ["BENCH_SCAN"] = "0"
 
     # Stage ordering is the round-4 lesson: the pallas kernel can wedge THE
     # DEVICE (an orphaned on-device DMA wait survives the probing process
@@ -372,16 +420,13 @@ def main() -> None:
 
     _arm("fused_step", PART1_TIMEOUT)
     fused = bench_fused_step()
-    bps = fused["median"]
+    best = _best_variant(fused)
+    bps = best["value"]               # raw median of the winning variant
     with _print_lock:
-        RESULT.update({
-            "value": round(bps, 2),
-            "vs_baseline": round(bps / BASELINE_BPS, 2),
-            "spread": {"min": fused["min"], "max": fused["max"],
-                       "reps": fused["reps"]},
-            "mfu": fused["mfu"],
-            "gather": fused["gather"],
-        })
+        RESULT.update(_headline_fields(best))
+        RESULT["gather"] = fused["gather"]
+        if fused.get("scan") is not None:
+            RESULT["scan_part1"] = fused["scan"]
     # part 1 is safe from here on: even a part-2 hang emits it (watchdog)
     print(f"[bench] part 1 done: {json.dumps(RESULT)}",
           file=sys.stderr, flush=True)
@@ -409,28 +454,48 @@ def main() -> None:
             _arm("fused_step_pallas", PART1_TIMEOUT)
             try:
                 pf = bench_fused_step()
+                pbest = _best_variant(pf)
                 with _print_lock:
                     RESULT["pallas_part1"] = {
                         "value": round(pf["median"], 2),
                         "spread": {"min": pf["min"], "max": pf["max"],
                                    "reps": pf["reps"]},
-                        "mfu": pf["mfu"]}
-                    if pf["median"] > bps:               # strict upgrade
-                        # (compare against the raw median — the rounded
-                        # RESULT["value"] could flip a sub-0.01 loss into
-                        # a "win")
-                        RESULT.update({
-                            "value": round(pf["median"], 2),
-                            "vs_baseline": round(
-                                pf["median"] / BASELINE_BPS, 2),
-                            "spread": RESULT["pallas_part1"]["spread"],
-                            "mfu": pf["mfu"], "gather": "pallas"})
+                        "scan": pf.get("scan"), "mfu": pf["mfu"]}
+                    # compare raw medians — the rounded RESULT["value"]
+                    # could flip a sub-0.01 loss into a "win"
+                    if pbest["value"] > bps:             # strict upgrade
+                        RESULT.update(_headline_fields(pbest))
+                        RESULT["gather"] = "pallas"
             except Exception as exc:
                 with _print_lock:
                     RESULT["pallas_error"] = (
                         f"fused step: {type(exc).__name__}: {exc}"[:400])
 
     _finish()
+
+
+def _best_variant(fused: dict) -> dict:
+    """The faster of the single-dispatch and scan-dispatch measurements
+    from one :func:`bench_fused_step` result, as headline-ready fields
+    (``value`` stays the RAW median so comparisons never hinge on
+    rounding)."""
+    scan = fused.get("scan")
+    if scan is not None and scan["median"] > fused["median"]:
+        return dict(value=scan["median"],
+                    spread={"min": scan["min"], "max": scan["max"],
+                            "reps": fused["reps"]},
+                    mfu=scan["mfu"], dispatch=f"scan{scan['k']}")
+    return dict(value=fused["median"],
+                spread={"min": fused["min"], "max": fused["max"],
+                        "reps": fused["reps"]},
+                mfu=fused["mfu"], dispatch="single")
+
+
+def _headline_fields(best: dict) -> dict:
+    return {"value": round(best["value"], 2),
+            "vs_baseline": round(best["value"] / BASELINE_BPS, 2),
+            "spread": best["spread"], "mfu": best["mfu"],
+            "dispatch": best["dispatch"]}
 
 
 def _finish() -> None:

@@ -281,6 +281,42 @@ def test_learner_core_end_to_end_with_frame_pool(key):
     assert np.isfinite(float(metrics["loss"]))
     assert float(metrics["grad_norm"]) > 0
 
+    # scan-of-K dispatch parity on the frame-chunk layout (scalar fields
+    # n_frames/n_trans and ref tables must slice correctly under scan):
+    # K fused steps == one fused_multi_step, bit-exact
+    k_steps = 2
+    rng2 = np.random.default_rng(9)
+    chunks, prios_l = [], []
+    for _ in range(k_steps):
+        c = _valid_chunk(pool, 8, 12, rng2)
+        c["obs_ref"] = np.tile(np.arange(s, dtype=np.int32), (8, 1))
+        c["next_ref"] = c["obs_ref"] + 1
+        chunks.append(c)
+        prios_l.append(np.abs(rng2.normal(size=8)).astype(np.float32) + .1)
+    keys = jax.random.split(jax.random.key(11), k_steps)
+    ts_a, st_a = ts2, state2
+    ts_b = jax.tree.map(jnp.copy, ts2)
+    st_b = jax.tree.map(jnp.copy, state2)
+    fused = core.jit_fused_step()
+    for i in range(k_steps):
+        ts_a, st_a, _ = fused(ts_a, st_a, chunks[i],
+                              jnp.asarray(prios_l[i]), keys[i],
+                              jnp.float32(0.4))
+    multi = core.jit_fused_multi_step()
+    stacked = {kk: jnp.stack([jnp.asarray(c[kk]) for c in chunks])
+               for kk in chunks[0]}
+    ts_m, st_m, mm = multi(ts_b, st_b, stacked,
+                           jnp.stack([jnp.asarray(p) for p in prios_l]),
+                           keys, jnp.float32(0.4))
+    assert mm["loss"].shape == (k_steps,)
+    np.testing.assert_array_equal(np.asarray(st_a.sum_tree),
+                                  np.asarray(st_m.sum_tree))
+    np.testing.assert_array_equal(np.asarray(st_a.frames),
+                                  np.asarray(st_m.frames))
+    for a, b in zip(jax.tree.leaves(ts_a.params),
+                    jax.tree.leaves(ts_m.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
 
 # -- config/shape validation (fail loudly, never corrupt the ring) ---------
 

@@ -84,6 +84,56 @@ def test_fused_step_ingests_and_trains(key):
     assert int(rs2.size) == 48 and int(ts2.step) == 1
 
 
+def test_fused_multi_step_matches_sequential(key):
+    """scan-of-K dispatch is bit-identical to K sequential fused steps:
+    same keys -> same samples -> same params/trees/metrics."""
+    k_steps = 4
+    rng = np.random.default_rng(5)
+
+    def chunk(i):
+        r = np.random.default_rng(100 + i)
+        return dict(
+            obs=r.normal(size=(16, 6)).astype(np.float32),
+            action=r.integers(0, 3, 16).astype(np.int32),
+            reward=r.normal(size=16).astype(np.float32),
+            next_obs=r.normal(size=(16, 6)).astype(np.float32),
+            discount=np.full(16, 0.99 ** 3, np.float32))
+
+    chunks = [chunk(i) for i in range(k_steps)]
+    prios = [np.abs(rng.normal(size=16)).astype(np.float32) + 0.1
+             for _ in range(k_steps)]
+    keys = jax.random.split(jax.random.key(3), k_steps)
+
+    core, ts_a, rs_a = _setup(key, target_interval=2)  # sync INSIDE the scan
+    rs_a = _fill(core, rs_a, 32)
+    ts_b = jax.tree.map(jnp.copy, ts_a)
+    rs_b = jax.tree.map(jnp.copy, rs_a)
+
+    fused = core.jit_fused_step()
+    for i in range(k_steps):
+        ts_a, rs_a, m_a = fused(ts_a, rs_a, chunks[i], jnp.asarray(prios[i]),
+                                keys[i], jnp.float32(0.4))
+
+    multi = core.jit_fused_multi_step()
+    stacked = {kk: jnp.stack([jnp.asarray(c[kk]) for c in chunks])
+               for kk in chunks[0]}
+    ts_m, rs_m, m_m = multi(ts_b, rs_b, stacked,
+                            jnp.stack([jnp.asarray(p) for p in prios]),
+                            keys, jnp.float32(0.4))
+
+    assert int(ts_m.step) == k_steps
+    assert m_m["loss"].shape == (k_steps,)
+    for a, b in zip(jax.tree.leaves(ts_a.params), jax.tree.leaves(ts_m.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ts_a.target_params),
+                    jax.tree.leaves(ts_m.target_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(rs_a.sum_tree),
+                                  np.asarray(rs_m.sum_tree))
+    np.testing.assert_allclose(float(m_a["loss"]),
+                               float(np.asarray(m_m["loss"])[-1]))
+
+
 @pytest.mark.slow
 def test_dqn_learns_cartpole():
     """End-to-end slice: reward must clearly beat random play.

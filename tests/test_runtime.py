@@ -356,6 +356,27 @@ def test_localhost_aql_topology():
             p.join(timeout=10)
 
 
+def test_chunk_sender_close_drains_inflight_window():
+    """close() must not drop the last window of chunks: linger=0 discards
+    unflushed messages, so close drains the ack-credit window first — a
+    full window sent then immediately closed still arrives intact."""
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    cfg = _test_config(1)
+    recv = ChunkReceiver(cfg.comms, queue_depth=16)
+    recv.start()
+    try:
+        s = ChunkSender(cfg.comms, "actor-0")
+        w = cfg.comms.max_outstanding_sends
+        for i in range(w):                 # exactly one full credit window
+            assert s.send_chunk({"i": i, "blob": b"y" * 20_000})
+        s.close(drain_s=10.0)              # returns early once acks land
+        got = sorted(recv.chunks.get(timeout=5.0)["i"] for _ in range(w))
+        assert got == list(range(w))
+    finally:
+        recv.stop()
+
+
 def test_chunk_receiver_decode_pipeline_credits_flow():
     """The decoder-pool receiver (reference learner.py:71-114's N pullers):
     with a credit window of 3, a sender can only complete >3 sends if acks
