@@ -76,6 +76,13 @@ class LearnerConfig:
     ingest_chunk: int = 512          # transitions folded into each fused step
     mesh_shape: tuple[int, ...] = (1,)
     mesh_axes: tuple[str, ...] = ("dp",)
+    # >1: when at least this many chunks are queued (i.e. the learner is
+    # the bottleneck), drain and run them as ONE lax.scan dispatch of
+    # scan_steps bit-identical fused steps — amortizes host->device
+    # round-trip latency, the dominant per-step overhead on relay-backed
+    # chips (training/learner.py:fused_multi_step).  DQN family,
+    # single-shard only; elsewhere it quietly stays at 1.
+    scan_steps: int = 1
 
 
 @dataclass(frozen=True)

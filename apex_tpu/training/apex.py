@@ -81,6 +81,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
     knobs (see :class:`ApexTrainer` for the reference wiring).
     """
 
+    # scan dispatch: ApexTrainer sets these when config.scan_steps > 1 on
+    # a single-shard DQN learner; None = chunk-at-a-time everywhere else
+    _multi = None
+    scan_steps = 1
+    scan_dispatches = 0      # K-step dispatches taken (observability)
+    # checkpoint/log bookkeeping persists ACROSS train() calls: a driver
+    # interleaving short train() bursts with eval must still hit its
+    # save/log cadence (per-call resets would silence both whenever
+    # interval > steps-per-call)
+    _last_save = 0
+    _last_log = 0
+
     # -- param plane -------------------------------------------------------
 
     def _publish(self) -> None:
@@ -155,7 +167,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
             last_publish = time.monotonic()
             t_end = last_publish + max_seconds
             episode_idx = 0
-            last_save = last_log = -1
+            # interval-since-last semantics (not ``% interval == 0``): a
+            # scan dispatch ticks the step counter by K, which can jump
+            # over any exact multiple.  Save/log marks live on self.
+            last_pub_step = self.steps_rate.total
             last_health = last_publish
             metrics = None      # no update has run yet this call (a restored
                                 # trainer can hit the log gate before one)
@@ -175,32 +190,67 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 behind = (warm and self.min_train_ratio is not None
                           and consumed < self.ingested * self.min_train_ratio)
 
-                msg = None
-                if not behind:
-                    msgs = pool.poll_chunks(1, timeout=0 if warm else 0.05)
-                    if msgs:
-                        msg = msgs[0]
+                # scan dispatch (config.scan_steps > 1): ask for K chunks
+                # only when the learner can take all K steps within BOTH
+                # the ratio budget and the remaining total_steps contract
+                # ("run total_steps MORE updates" — a K-dispatch must not
+                # overshoot it) — exactly the chunk-backlog regime where
+                # dispatch latency, not data supply, bounds throughput
+                want = 1
+                if (self._multi is not None and warm
+                        and target_steps - self.steps_rate.total
+                        >= self.scan_steps
+                        and self.steps_rate.total + self.scan_steps - 1
+                        < budget):
+                    want = self.scan_steps
 
-                if msg is not None:
-                    prios = jnp.asarray(msg["priorities"])
-                    n_new = int(msg["n_trans"])
-                    payload = msg["payload"]
-                    # The replay-ratio cap applies on the chunk path too: an
-                    # over-budget learner ingests WITHOUT the fused train
-                    # half, so the documented ``train_ratio`` really is the
-                    # ceiling (ingesting raises the budget for later steps).
-                    if warm and self.steps_rate.total < budget:
-                        self.key, k = jax.random.split(self.key)
-                        self.train_state, self.replay_state, metrics = \
-                            self._fused(self.train_state, self.replay_state,
-                                        payload, prios, k,
-                                        jnp.float32(self._beta()))
-                        self.steps_rate.tick()
-                    else:
-                        self.replay_state = self._ingest(
-                            self.replay_state, payload, prios)
+                msgs = []
+                if not behind:
+                    msgs = pool.poll_chunks(want, timeout=0 if warm else 0.05)
+
+                if want > 1 and len(msgs) == want:
+                    # full scan batch: K chunks -> one device dispatch
+                    prios = jnp.stack(
+                        [jnp.asarray(m["priorities"]) for m in msgs])
+                    payload = jax.tree.map(
+                        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                        *[m["payload"] for m in msgs])
+                    n_new = sum(int(m["n_trans"]) for m in msgs)
+                    self.key, k = jax.random.split(self.key)
+                    self.train_state, self.replay_state, mm = \
+                        self._multi(self.train_state, self.replay_state,
+                                    payload, prios,
+                                    jax.random.split(k, want),
+                                    jnp.float32(self._beta()))
+                    metrics = jax.tree.map(lambda x: x[-1], mm)
+                    self.steps_rate.tick(want)
+                    self.scan_dispatches += 1
                     self.ingested += n_new
                     self.frames_rate.tick(n_new)
+                elif msgs:
+                    # single-chunk path (and scan shortfalls, one by one)
+                    for msg in msgs:
+                        prios = jnp.asarray(msg["priorities"])
+                        n_new = int(msg["n_trans"])
+                        payload = msg["payload"]
+                        # The replay-ratio cap applies on the chunk path
+                        # too: an over-budget learner ingests WITHOUT the
+                        # fused train half, so the documented
+                        # ``train_ratio`` really is the ceiling (ingesting
+                        # raises the budget for later steps).
+                        if warm and self.steps_rate.total < budget:
+                            self.key, k = jax.random.split(self.key)
+                            self.train_state, self.replay_state, metrics = \
+                                self._fused(self.train_state,
+                                            self.replay_state,
+                                            payload, prios, k,
+                                            jnp.float32(self._beta()))
+                            self.steps_rate.tick()
+                        else:
+                            self.replay_state = self._ingest(
+                                self.replay_state, payload, prios)
+                        self.ingested += n_new
+                        self.frames_rate.tick(n_new)
                 elif warm and self.steps_rate.total < budget:
                     self.key, k = jax.random.split(self.key)
                     self.train_state, self.replay_state, metrics = \
@@ -211,11 +261,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     time.sleep(0.002)   # replay-ratio cap reached
 
                 steps = self.steps_rate.total
-                if (self.checkpointer is not None and steps
-                        and steps % cfg.learner.save_interval == 0
-                        and steps != last_save):
+                if (self.checkpointer is not None
+                        and steps - self._last_save
+                        >= cfg.learner.save_interval):
                     self.save_checkpoint()
-                    last_save = steps
+                    self._last_save = steps
                 # Pre-first-step republish (slow cadence) is needed only for
                 # socket pools: a TCP subscriber that joined after the
                 # initial publish would otherwise never receive params
@@ -226,7 +276,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # ingest thread on param serialization.
                 if steps:
                     due = (now - last_publish >= self.publish_min_seconds
-                           and (steps % cfg.learner.publish_interval == 0
+                           and (steps - last_pub_step
+                                >= cfg.learner.publish_interval
                                 or now - last_publish
                                 > 10 * self.publish_min_seconds))
                 else:
@@ -236,6 +287,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 if due:
                     self._publish()
                     last_publish = now
+                    last_pub_step = steps
 
                 # Failure detection (beyond the reference, SURVEY.md §5.3:
                 # its fleets never notice actor death): crashed workers are
@@ -259,15 +311,15 @@ class ConcurrentTrainer(CheckpointableTrainer):
                          "actor_id": stat.actor_id}, episode_idx)
                     episode_idx += 1
 
-                if warm and steps and metrics is not None \
-                        and steps % log_every == 0 and steps != last_log:
+                if warm and metrics is not None \
+                        and steps - self._last_log >= log_every:
                     self.log.scalars(
                         {k: float(v) for k, v in metrics.items()}
                         | {"bps": self.steps_rate.rate,
                            "fps": self.frames_rate.rate,
                            "param_version": self.param_version,
                            "ingested": self.ingested}, steps)
-                    last_log = steps
+                    self._last_log = steps
         finally:
             pool.cleanup()
             stop = self._stop_requested
@@ -294,6 +346,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
         self.ingested = meta["ingested"]
         self.steps_rate.total = meta["steps"]
         self.param_version = meta["param_version"]
+        # a restored trainer does not owe an immediate save/log: its marks
+        # continue from the restored step count
+        self._last_save = self._last_log = meta["steps"]
 
 
 class ApexTrainer(ConcurrentTrainer):
@@ -379,6 +434,9 @@ class ApexTrainer(ConcurrentTrainer):
             self._fused = self.core.jit_fused_step()
             self._train = self.core.jit_train_step()
             self._ingest = self.core.jit_ingest()
+            if lc.scan_steps > 1:
+                self.scan_steps = lc.scan_steps
+                self._multi = self.core.jit_fused_multi_step()
 
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()

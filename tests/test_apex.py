@@ -36,6 +36,27 @@ def test_apex_pipeline_mechanics():
     assert np.isfinite(score)
 
 
+@pytest.mark.slow
+def test_apex_scan_dispatch_mechanics():
+    """config.scan_steps > 1: when chunks back up, the trainer drains K at
+    a time through ONE lax.scan dispatch (bit-parity with sequential steps
+    is pinned in test_learner/test_frame_pool; this proves the concurrent
+    wiring — counters, cadences, shutdown — survives K-step jumps)."""
+    import dataclasses
+
+    cfg = small_test_config(capacity=1024, batch_size=32, n_actors=2)
+    cfg = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, scan_steps=2, publish_interval=3, save_interval=10))
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05)
+    assert trainer._multi is not None
+    trainer.train(total_steps=40, max_seconds=120)
+
+    assert trainer.steps_rate.total >= 40
+    assert trainer.scan_dispatches > 0, "scan path never fired"
+    assert trainer.param_version >= 2
+    assert all(not p.is_alive() for p in trainer.pool.procs)
+
+
 def test_trainer_rejects_replay_over_hbm_budget():
     """Mis-sized replay configs must fail at construction with an
     actionable error, not an opaque XLA OOM mid-run."""
