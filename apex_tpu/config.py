@@ -80,8 +80,8 @@ class LearnerConfig:
     # the bottleneck), drain and run them as ONE lax.scan dispatch of
     # scan_steps bit-identical fused steps — amortizes host->device
     # round-trip latency, the dominant per-step overhead on relay-backed
-    # chips (training/learner.py:fused_multi_step).  DQN family,
-    # single-shard only; elsewhere it quietly stays at 1.
+    # chips (training/learner.py:scan_fused_steps).  Both families (DQN
+    # and AQL), single-shard only; on a dp>1 mesh it quietly stays at 1.
     scan_steps: int = 1
 
 

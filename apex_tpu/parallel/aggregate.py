@@ -15,6 +15,23 @@ import jax
 import numpy as np
 
 
+def stack_chunk_messages(msgs: list) -> tuple:
+    """Stack K chunk messages on a new leading axis, HOST-side.
+
+    ``(payload, priorities, total_n_trans)`` — np.stack so the stacked
+    trees cross to the device in ONE transfer at the jitted call
+    boundary (per-item device ops would add exactly the dispatch
+    overhead the consumers exist to amortize).  Payloads may nest (frame
+    chunks carry an "extras" dict of per-transition sidecars).  Used by
+    the dp aggregator (leading axis = chips) and the scan dispatch
+    (leading axis = scan steps)."""
+    payload = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *[m["payload"] for m in msgs])
+    prios = np.stack([np.asarray(m["priorities"]) for m in msgs])
+    return payload, prios, sum(int(m["n_trans"]) for m in msgs)
+
+
 class ChunkAggregator:
     """Pool wrapper: groups ``n_dp`` chunk messages into one stacked
     sharded message; every other pool method delegates, so the shared
@@ -68,15 +85,7 @@ class ChunkAggregator:
             if len(self._buf) < self.n_dp:
                 break
             msgs, self._buf = self._buf[:self.n_dp], self._buf[self.n_dp:]
-            # tree-stack: payloads may nest (frame chunks carry an
-            # "extras" dict of per-transition sidecars)
-            payload = jax.tree.map(
-                lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                *[m["payload"] for m in msgs])
-            out.append({
-                "payload": payload,
-                "priorities": np.stack([np.asarray(m["priorities"])
-                                        for m in msgs]),
-                "n_trans": sum(int(m["n_trans"]) for m in msgs),
-            })
+            payload, prios, n_trans = stack_chunk_messages(msgs)
+            out.append({"payload": payload, "priorities": prios,
+                        "n_trans": n_trans})
         return out

@@ -33,6 +33,7 @@ import numpy as np
 
 from apex_tpu.actors.pool import ActorPool
 from apex_tpu.config import ApexConfig
+from apex_tpu.parallel.aggregate import stack_chunk_messages
 from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
                                     unstacked_env_spec)
 from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
@@ -210,12 +211,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
                 if want > 1 and len(msgs) == want:
                     # full scan batch: K chunks -> one device dispatch
-                    prios = jnp.stack(
-                        [jnp.asarray(m["priorities"]) for m in msgs])
-                    payload = jax.tree.map(
-                        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                        *[m["payload"] for m in msgs])
-                    n_new = sum(int(m["n_trans"]) for m in msgs)
+                    payload, prios, n_new = stack_chunk_messages(msgs)
                     self.key, k = jax.random.split(self.key)
                     self.train_state, self.replay_state, mm = \
                         self._multi(self.train_state, self.replay_state,

@@ -37,6 +37,7 @@ from apex_tpu.ops.losses import (aql_proposal_loss, aql_q_loss,
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.device import DeviceReplay, ReplayState
 from apex_tpu.training.apex import ConcurrentTrainer
+from apex_tpu.training.learner import scan_fused_steps
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
                                           Checkpointer)
 from apex_tpu.training.state import TrainState
@@ -141,6 +142,14 @@ class AQLCore:
         rs = self.ingest(rs, ingest_batch, ingest_prios)
         return self.train_step(ts, rs, key, beta)
 
+    def fused_multi_step(self, ts, rs, ingest_batches, ingest_prios, keys,
+                         beta):
+        """K fused steps in one dispatch (the two-loss AQL update scans
+        exactly like the DQN one) — see
+        :func:`apex_tpu.training.learner.scan_fused_steps`."""
+        return scan_fused_steps(self, ts, rs, ingest_batches, ingest_prios,
+                                keys, beta)
+
     def jit_train_step(self):
         return jax.jit(self.train_step, donate_argnums=(0, 1))
 
@@ -149,6 +158,9 @@ class AQLCore:
 
     def jit_fused_step(self):
         return jax.jit(self.fused_step, donate_argnums=(0, 1))
+
+    def jit_fused_multi_step(self):
+        return jax.jit(self.fused_multi_step, donate_argnums=(0, 1))
 
 
 class AQLTransitionBuilder:
@@ -548,6 +560,9 @@ class AQLApexTrainer(ConcurrentTrainer):
             self._fused = self.core.jit_fused_step()
             self._train = self.core.jit_train_step()
             self._ingest = self.core.jit_ingest()
+            if cfg.learner.scan_steps > 1:
+                self.scan_steps = cfg.learner.scan_steps
+                self._multi = self.core.jit_fused_multi_step()
         self.log = MetricLogger("learner", logdir, verbose=verbose)
         self.steps_rate = RateCounter()
         self.frames_rate = RateCounter()

@@ -130,29 +130,9 @@ class LearnerCore:
                          replay_state: ReplayState, ingest_batches: Any,
                          ingest_prios: jax.Array, keys: jax.Array,
                          beta: jax.Array):
-        """K fused steps in ONE dispatch: ``lax.scan`` over chunk/prio/key
-        stacks with a leading axis of K.
-
-        Each scan iteration is bit-identical to one :meth:`fused_step`
-        (same ingest -> sample -> update -> write-back program, same keys
-        -> same samples), so the numerical contract is unchanged — only
-        the host<->device round-trip count drops from K to 1.  That
-        matters because dispatch latency is pure overhead on the learner
-        hot path (the reference pays it as queue.get + H2D per batch,
-        ``origin_repo/learner.py:152-170``; this framework pays it as an
-        RPC on relay-backed chips).  Metrics come back stacked ``[K]``.
-        """
-        def body(carry, xs):
-            ts, rs = carry
-            chunk, prios, key = xs
-            rs = self.ingest(rs, chunk, prios)
-            ts, rs, metrics = self.train_step(ts, rs, key, beta)
-            return (ts, rs), metrics
-
-        (train_state, replay_state), metrics = jax.lax.scan(
-            body, (train_state, replay_state),
-            (ingest_batches, ingest_prios, keys))
-        return train_state, replay_state, metrics
+        """K fused steps in ONE dispatch — see :func:`scan_fused_steps`."""
+        return scan_fused_steps(self, train_state, replay_state,
+                                ingest_batches, ingest_prios, keys, beta)
 
     # -- jitted entry points (donated buffers) -----------------------------
 
@@ -167,6 +147,35 @@ class LearnerCore:
 
     def jit_fused_multi_step(self):
         return jax.jit(self.fused_multi_step, donate_argnums=(0, 1))
+
+
+def scan_fused_steps(core, train_state, replay_state, ingest_batches,
+                     ingest_prios, keys, beta):
+    """K fused steps in ONE dispatch: ``lax.scan`` over chunk/prio/key
+    stacks with a leading axis of K.  Works for ANY core exposing
+    ``ingest`` + ``train_step`` with the shared signature (DQN
+    :class:`LearnerCore`, :class:`apex_tpu.training.aql.AQLCore`).
+
+    Each scan iteration is bit-identical to one ``fused_step`` (same
+    ingest -> sample -> update -> write-back program, same keys -> same
+    samples), so the numerical contract is unchanged — only the
+    host<->device round-trip count drops from K to 1.  That matters
+    because dispatch latency is pure overhead on the learner hot path
+    (the reference pays it as queue.get + H2D per batch,
+    ``origin_repo/learner.py:152-170``; this framework pays it as an RPC
+    on relay-backed chips).  Metrics come back stacked ``[K]``.
+    """
+    def body(carry, xs):
+        ts, rs = carry
+        chunk, prios, key = xs
+        rs = core.ingest(rs, chunk, prios)
+        ts, rs, metrics = core.train_step(ts, rs, key, beta)
+        return (ts, rs), metrics
+
+    (train_state, replay_state), metrics = jax.lax.scan(
+        body, (train_state, replay_state),
+        (ingest_batches, ingest_prios, keys))
+    return train_state, replay_state, metrics
 
 
 def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,

@@ -215,6 +215,88 @@ def test_aql_apex_pipeline_mechanics():
     assert np.isfinite(t.evaluate(episodes=1, max_steps=50))
 
 
+def test_aql_fused_multi_step_matches_sequential(key):
+    """scan-of-K parity for the AQL core: the two-loss update with its
+    NoisyNet key splits must be bit-identical inside lax.scan."""
+    from apex_tpu.envs.registry import make_env
+    from apex_tpu.training.aql import aql_model_spec, build_aql
+
+    cfg = small_test_config(capacity=256, batch_size=16,
+                            env_id="ApexContinuousNav-v0")
+    cfg = cfg.replace(aql=dataclasses.replace(cfg.aql, propose_sample=4,
+                                              uniform_sample=4))
+    env = make_env(cfg.env.env_id, cfg.env, seed=0)
+    obs_shape = env.observation_space.shape
+    spec = aql_model_spec(cfg, env)
+    env.close()
+    model, ts, replay, rs, core = build_aql(
+        cfg, spec, obs_shape, np.float32, key)
+    t = model.total_sample
+    a_dim = spec["action_dim"]
+    k_steps = 3
+    rng = np.random.default_rng(2)
+
+    def chunk(i):
+        r = np.random.default_rng(50 + i)
+        n = 16
+        return dict(
+            obs=r.normal(size=(n,) + obs_shape).astype(np.float32),
+            action=r.integers(0, t, n).astype(np.int32),
+            reward=r.normal(size=n).astype(np.float32),
+            next_obs=r.normal(size=(n,) + obs_shape).astype(np.float32),
+            discount=np.full(n, 0.99, np.float32),
+            a_mu=r.normal(size=(n, t, a_dim)).astype(np.float32))
+
+    chunks = [chunk(i) for i in range(k_steps)]
+    prios = [np.abs(rng.normal(size=16)).astype(np.float32) + 0.1
+             for _ in range(k_steps)]
+    keys = jax.random.split(jax.random.key(4), k_steps)
+    # warm the buffer so sampling has mass before the first scanned step
+    rs = core.jit_ingest()(rs, chunks[0], jnp.asarray(prios[0]))
+    ts_b = jax.tree.map(jnp.copy, ts)
+    rs_b = jax.tree.map(jnp.copy, rs)
+
+    fused = core.jit_fused_step()
+    for i in range(k_steps):
+        ts, rs, m_a = fused(ts, rs, chunks[i], jnp.asarray(prios[i]),
+                            keys[i], jnp.float32(0.4))
+    multi = core.jit_fused_multi_step()
+    stacked = {kk: jnp.stack([jnp.asarray(c[kk]) for c in chunks])
+               for kk in chunks[0]}
+    ts_m, rs_m, m_m = multi(ts_b, rs_b, stacked,
+                            jnp.stack([jnp.asarray(p) for p in prios]),
+                            keys, jnp.float32(0.4))
+    assert int(ts_m.step) == k_steps
+    assert m_m["loss"].shape == (k_steps,)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ts_m.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(rs.sum_tree),
+                                  np.asarray(rs_m.sum_tree))
+    np.testing.assert_allclose(float(m_a["loss"]),
+                               float(np.asarray(m_m["loss"])[-1]))
+
+
+@pytest.mark.slow
+def test_aql_apex_scan_dispatch_mechanics():
+    """config.scan_steps wires the AQL core's fused_multi_step into the
+    concurrent loop exactly like the DQN family (two-loss update +
+    NoisyNet keys inside lax.scan)."""
+    from apex_tpu.training.aql import AQLApexTrainer
+
+    cfg = small_test_config(capacity=2048, batch_size=32, n_actors=2,
+                            env_id="ApexContinuousNav-v0")
+    cfg = cfg.replace(
+        aql=dataclasses.replace(cfg.aql, propose_sample=8,
+                                uniform_sample=16),
+        learner=dataclasses.replace(cfg.learner, scan_steps=2))
+    t = AQLApexTrainer(cfg, publish_min_seconds=0.05)
+    assert t._multi is not None
+    t.train(total_steps=30, max_seconds=120)
+    assert t.steps_rate.total >= 30
+    assert t.scan_dispatches > 0, "scan path never fired"
+    assert all(not p.is_alive() for p in t.pool.procs)
+
+
 @pytest.mark.slow
 def test_aql_apex_vector_actors():
     """Vectorized AQL actors: 1 process x 4 env slots act through ONE
