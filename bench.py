@@ -347,6 +347,12 @@ def bench_end_to_end() -> dict:
 
     n_actors, n_envs = 4, 8          # 32 ladder slots in 4 processes
     env_id = os.environ.get("BENCH_E2E_ENV", "ApexCatch-v0")
+    # scan dispatch in the live pipeline only on TPU (cf. part 1's gate:
+    # the XLA:CPU conv-backward-in-loop pathology would throttle the
+    # whole e2e run, not just skew one measurement)
+    scan_steps = int(os.environ.get("BENCH_E2E_SCAN",
+                                    4 if RESULT.get("platform") == "tpu"
+                                    else 1))
     cfg = ApexConfig(
         env=EnvConfig(env_id=env_id, frame_stack=FRAME_STACK,
                       clip_rewards=False, episodic_life=False),
@@ -354,7 +360,8 @@ def bench_end_to_end() -> dict:
                             warmup=min(2048, 4 * BATCH), frame_pool=True),
         learner=LearnerConfig(batch_size=BATCH, ingest_chunk=BATCH,
                               compute_dtype="bfloat16",
-                              target_update_interval=500),
+                              target_update_interval=500,
+                              scan_steps=scan_steps),
         actor=ActorConfig(n_actors=n_actors, n_envs_per_actor=n_envs,
                           send_interval=64),
     )
@@ -382,6 +389,8 @@ def bench_end_to_end() -> dict:
             "total_steps": trainer.steps_rate.total,
             "actors": n_actors, "envs_per_actor": n_envs,
             "data_plane": data_plane,
+            "scan_steps": scan_steps,
+            "scan_dispatches": trainer.scan_dispatches,
             "seconds": round(dt, 1)}
 
 
