@@ -130,6 +130,25 @@ class EnvConfig:
 
 
 @dataclass(frozen=True)
+class R2D2Config:
+    """Recurrent-family (R2D2-style) hyperparameters.
+
+    The reference lists recurrent DQN as an unimplemented TODO
+    (``README.md:5``); these defaults follow the R2D2 recipe scaled to the
+    reference's network widths.  Sequence length stored per replay item is
+    ``burn_in + unroll + n_steps``.
+    """
+
+    burn_in: int = 8            # state-warmup prefix, no loss/gradient
+    unroll: int = 16            # loss positions per sequence
+    # sequence start spacing; None derives unroll // 2 (R2D2's 1/2
+    # overlap) so raising unroll keeps the documented overlap invariant
+    stride: int | None = None
+    lstm_features: int = 128    # recurrent width (reference head scale;
+                                # R2D2 itself uses 512 — raise for Atari)
+
+
+@dataclass(frozen=True)
 class AQLConfig:
     """AQL proposal-action Q-learning knobs (reference: model.py:170, AQL.py:41-42)."""
 
@@ -174,6 +193,7 @@ class ApexConfig:
     learner: LearnerConfig = field(default_factory=LearnerConfig)
     actor: ActorConfig = field(default_factory=ActorConfig)
     aql: AQLConfig = field(default_factory=AQLConfig)
+    r2d2: R2D2Config = field(default_factory=R2D2Config)
     comms: CommsConfig = field(default_factory=CommsConfig)
 
     def replace(self, **sections: Any) -> "ApexConfig":

@@ -77,6 +77,8 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
     if env_id.startswith("ApexCartPole"):
         env = (toy.CartPoleEnv(max_episode_steps=max_episode_steps)
                if max_episode_steps is not None else toy.CartPoleEnv())
+        if "PO" in env_id:      # ApexCartPolePO-v0: velocities hidden
+            env = toy.VelocityMask(env)
     elif env_id.startswith("ApexContinuousNav"):
         env = (toy.ContinuousNavEnv(max_episode_steps=max_episode_steps)
                if max_episode_steps is not None else toy.ContinuousNavEnv())

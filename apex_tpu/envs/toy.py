@@ -75,6 +75,24 @@ class CartPoleEnv(gym.Env):
         return (self._state.astype(np.float32), 1.0, terminated, truncated, {})
 
 
+class VelocityMask(gym.ObservationWrapper):
+    """Hide CartPole's velocity components — the classic DRQN/partially-
+    observable variant (Hausknecht & Stone 2015): the agent sees only
+    ``(x, theta)`` and must infer velocities from history, which a
+    feedforward Q-network cannot do and a recurrent one can.  This is the
+    learning certificate env for the R2D2 family."""
+
+    _KEEP = np.array([0, 2])
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        self.observation_space = gym.spaces.Box(-np.inf, np.inf, (2,),
+                                                np.float32)
+
+    def observation(self, obs):
+        return np.asarray(obs, np.float32)[self._KEEP]
+
+
 class ContinuousNavEnv(gym.Env):
     """Continuous-action navigation: drive a point to the origin.
 

@@ -1,0 +1,131 @@
+"""Recurrent dueling DQN (R2D2-style) in flax.linen.
+
+The reference lists "recurrent DQN" as an unimplemented TODO
+(``README.md:5``); this module implements it TPU-first, following the
+R2D2 recipe (Kapturowski et al. 2019: recurrent replay, stored recurrent
+state, burn-in) on top of the same Nature trunk / dueling-head geometry as
+:class:`apex_tpu.models.dueling.DuelingDQN` (``model.py:14-107``).
+
+Design notes:
+
+* The LSTM unroll is a ``flax.linen.scan`` over the time axis — one
+  compiled ``lax.scan``, weights broadcast, no Python loop.  Trunk and
+  heads run batched over ``B*L`` frames around the scan, so the convs
+  stay one big MXU-friendly batch; only the cell itself is sequential.
+* One ``__call__`` serves sequences AND single steps (actors pass
+  ``L=1``), so there is exactly one parameter structure and no
+  train/act weight-translation.
+* The carry is explicit state threaded by the caller — actors store it
+  per environment and ship the value at sequence start to the replay
+  (the R2D2 "stored state" strategy), rather than hiding it in module
+  state.
+* With a recurrent core the frame-stack becomes redundant (the LSTM IS
+  the memory); the family defaults to single frames, which also
+  quarters the observation bytes per step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.models.dueling import orthogonal_init
+
+
+class RecurrentDuelingDQN(nn.Module):
+    """Dueling Q-network with an LSTM between the trunk and the heads.
+
+    ``__call__(x_seq, carry)`` takes ``x_seq [B, L, *obs]`` and carry
+    ``(c, h)`` each ``[B, lstm_features]``; returns ``(q_seq [B, L, A],
+    new_carry)``.
+    """
+
+    num_actions: int
+    obs_is_image: bool = True
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    scale_uint8: bool = True
+    trunk_features: Sequence[int] = (32, 64, 64)
+    lstm_features: int = 128
+    head_width: int = 128
+
+    def initial_state(self, batch_size: int):
+        """Zero carry ``(c, h)`` — parameter-free, callable pre-init.
+        f32: the carry crosses step boundaries and accumulates."""
+        z = jnp.zeros((batch_size, self.lstm_features), jnp.float32)
+        return (z, z)
+
+    @nn.compact
+    def __call__(self, x_seq: jax.Array, carry):
+        dt = self.compute_dtype
+        b, length = x_seq.shape[0], x_seq.shape[1]
+        x = x_seq.reshape((b * length,) + x_seq.shape[2:])
+        if x.dtype == jnp.uint8 and self.scale_uint8:
+            x = x.astype(dt) / jnp.asarray(255.0, dt)
+        else:
+            x = x.astype(dt)
+
+        if self.obs_is_image:
+            f1, f2, f3 = self.trunk_features
+            for feats, kernel, stride in (
+                    (f1, (8, 8), (4, 4)),
+                    (f2, (4, 4), (2, 2)),
+                    (f3, (3, 3), (1, 1))):
+                x = nn.Conv(feats, kernel, strides=stride, padding="VALID",
+                            dtype=dt, kernel_init=orthogonal_init(),
+                            bias_init=nn.initializers.zeros)(x)
+                x = nn.relu(x)
+            x = x.reshape((b * length, -1))
+        else:
+            x = nn.Dense(128, dtype=dt, kernel_init=orthogonal_init(),
+                         bias_init=nn.initializers.zeros)(x)
+            x = nn.relu(x)
+
+        feats = x.reshape(b, length, -1).astype(jnp.float32)
+        # time-axis scan of one LSTM cell: params broadcast across steps.
+        # Carry math stays f32 (bf16 carries drift over long unrolls).
+        scan_cell = nn.scan(nn.OptimizedLSTMCell,
+                            variable_broadcast="params",
+                            split_rngs={"params": False},
+                            in_axes=1, out_axes=1)
+        carry, h_seq = scan_cell(self.lstm_features, name="lstm")(
+            carry, feats)
+
+        h = h_seq.reshape(b * length, -1).astype(dt)
+
+        def head(out_dim: int, name: str) -> jax.Array:
+            y = nn.Dense(self.head_width, dtype=dt,
+                         kernel_init=orthogonal_init(),
+                         bias_init=nn.initializers.zeros,
+                         name=f"{name}_hidden")(h)
+            y = nn.relu(y)
+            return nn.Dense(out_dim, dtype=dt,
+                            kernel_init=orthogonal_init(),
+                            bias_init=nn.initializers.zeros,
+                            name=f"{name}_out")(y)
+
+        advantage = head(self.num_actions, "advantage").astype(jnp.float32)
+        value = head(1, "value").astype(jnp.float32)
+        q = value + advantage - advantage.mean(axis=1, keepdims=True)
+        return q.reshape(b, length, self.num_actions), carry
+
+
+def make_recurrent_policy_fn(model: RecurrentDuelingDQN):
+    """Jittable stateful epsilon-greedy step: ``(params, obs [B, *obs],
+    carry, epsilon, key) -> (actions [B], q [B, A], new_carry)``.  The
+    caller owns the carry (one per env slot) and must reset it to
+    ``model.initial_state`` on episode boundaries."""
+
+    def policy(params, obs, carry, epsilon, key):
+        q_seq, carry = model.apply(params, obs[:, None], carry)
+        q = q_seq[:, 0]
+        explore_key, action_key = jax.random.split(key)
+        greedy = q.argmax(axis=1)
+        random_actions = jax.random.randint(
+            action_key, greedy.shape, 0, model.num_actions)
+        explore = jax.random.uniform(explore_key, greedy.shape) < epsilon
+        return jnp.where(explore, random_actions, greedy), q, carry
+
+    return policy

@@ -94,6 +94,97 @@ def double_dqn_loss(
                           q_taken=q_taken)
 
 
+def r2d2_loss(
+    apply_fn: Callable[..., tuple],
+    params: Any,
+    target_params: Any,
+    batch: dict[str, jax.Array],
+    weights: jax.Array,
+    *,
+    burn_in: int,
+    n_steps: int,
+    eta: float = 0.9,
+    eps: float = 1e-6,
+) -> tuple[jax.Array, TDOutput]:
+    """Sequence double-DQN loss for the recurrent family (R2D2 recipe on
+    the reference's TD conventions).
+
+    ``apply_fn(params, obs_seq [B, L, *obs], carry) -> (q [B, L, A],
+    carry)`` is the recurrent network.  ``batch``: ``obs [B, T, *obs]``,
+    ``action``/``reward`` ``[B, T]``, ``discount [B, T]`` =
+    ``gamma * (1 - done)`` per STEP (0 at terminals — padded steps also
+    carry 0, so n-step products truncate naturally), ``mask [B, T]`` = 1
+    on real steps, ``state_c``/``state_h`` ``[B, H]`` — the actor's
+    recurrent state at sequence start (R2D2 stored-state).  Sequence
+    geometry: ``T = burn_in + unroll + n_steps``; the loss covers the
+    ``unroll`` positions after burn-in.
+
+    Burn-in: both nets unroll the prefix from the stored state and the
+    resulting carries are ``stop_gradient``-ed — the prefix only warms
+    the state, contributing no gradient and no loss terms.
+
+    Per-sequence priorities use R2D2's mix ``eta * max_t |td| +
+    (1 - eta) * mean_t |td|`` — the sequence analogue of the reference's
+    mixed-max heuristic (``utils.py:77``).
+    """
+    obs = batch["obs"]
+    t_total = obs.shape[1]
+    unroll = t_total - burn_in - n_steps
+    if unroll < 1:
+        raise ValueError(
+            f"sequence length {t_total} too short for burn_in={burn_in} "
+            f"+ n_steps={n_steps} + at least one unroll step")
+
+    carry0 = (batch["state_c"], batch["state_h"])
+    if burn_in:
+        _, carry_on = apply_fn(params, obs[:, :burn_in], carry0)
+        _, carry_tg = apply_fn(target_params, obs[:, :burn_in], carry0)
+        carry_on = jax.lax.stop_gradient(carry_on)
+        carry_tg = jax.lax.stop_gradient(carry_tg)
+    else:
+        carry_on = carry_tg = carry0
+
+    body = obs[:, burn_in:]                       # [B, unroll + n, *obs]
+    q_seq, _ = apply_fn(params, body, carry_on)   # [B, unroll + n, A]
+    qt_seq, _ = apply_fn(target_params, body, carry_tg)
+
+    r = batch["reward"][:, burn_in:]
+    d = batch["discount"][:, burn_in:]
+    m = batch["mask"][:, burn_in:]
+
+    # n-step returns per unroll position; discount 0 at terminals/padding
+    # truncates every product past end-of-episode
+    returns = jnp.zeros(r.shape[:1] + (unroll,), jnp.float32)
+    disc_prod = jnp.ones_like(returns)
+    for i in range(n_steps):
+        returns = returns + disc_prod * r[:, i:i + unroll]
+        disc_prod = disc_prod * d[:, i:i + unroll]
+
+    next_online = q_seq[:, n_steps:n_steps + unroll]
+    next_target = qt_seq[:, n_steps:n_steps + unroll]
+    a_star = next_online.argmax(axis=-1)
+    bootstrap = jnp.take_along_axis(next_target, a_star[..., None],
+                                    axis=-1)[..., 0]
+    target = returns + disc_prod * bootstrap
+
+    actions = batch["action"][:, burn_in:burn_in + unroll].astype(jnp.int32)
+    q_taken = jnp.take_along_axis(q_seq[:, :unroll], actions[..., None],
+                                  axis=-1)[..., 0]
+    td = jax.lax.stop_gradient(target) - q_taken
+    lmask = m[:, :unroll]
+    n_valid = jnp.maximum(lmask.sum(axis=1), 1.0)
+
+    loss = ((huber(td) * lmask).sum(axis=1) / n_valid * weights).mean()
+
+    td_abs = jnp.abs(td) * lmask
+    seq_max = td_abs.max(axis=1)
+    seq_mean = td_abs.sum(axis=1) / n_valid
+    priorities = eta * seq_max + (1.0 - eta) * seq_mean + eps
+    q_mean = (q_taken * lmask).sum(axis=1) / n_valid
+    return loss, TDOutput(loss=loss, td_abs=seq_mean,
+                          priorities=priorities, q_taken=q_mean)
+
+
 def make_optimizer(lr: float = 6.25e-5, decay: float = 0.95,
                    eps: float = 1.5e-7, centered: bool = True,
                    max_grad_norm: float = 40.0,

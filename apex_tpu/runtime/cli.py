@@ -45,9 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "dqn", "aql",
-                            "apex", "enjoy"],
+                            "r2d2", "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator; "
-                        "single-host drivers: dqn/aql/apex; "
+                        "single-host drivers: dqn/aql/r2d2/apex; "
                         "enjoy: eval a checkpoint")
     p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
                    choices=["dqn", "aql"])
@@ -176,7 +176,8 @@ def main(argv: list[str] | None = None) -> int:
     cfg = config_from_args(args)
     identity = identity_from_args(args)
 
-    if args.profile_dir and args.role in ("learner", "apex", "dqn", "aql"):
+    if args.profile_dir and args.role in ("learner", "apex", "dqn", "aql",
+                                          "r2d2"):
         from apex_tpu.utils.profiling import trace
         profile_ctx = trace(args.profile_dir)
     else:
@@ -209,10 +210,13 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       episodes=args.episodes, logdir=args.logdir,
                       verbose=args.verbose,
                       barrier_timeout_s=args.barrier_timeout)
-    elif args.role in ("dqn", "aql", "apex"):
+    elif args.role in ("dqn", "aql", "r2d2", "apex"):
         # single-host drivers share one construct -> restore? -> train path
         if args.role == "dqn":
             from apex_tpu.training.dqn import DQNTrainer as trainer_cls
+            extra, train_kw = {}, dict(total_frames=args.total_frames)
+        elif args.role == "r2d2":
+            from apex_tpu.training.r2d2 import R2D2Trainer as trainer_cls
             extra, train_kw = {}, dict(total_frames=args.total_frames)
         elif args.role == "aql":
             from apex_tpu.training.aql import AQLTrainer as trainer_cls

@@ -174,7 +174,7 @@ def config_from_meta(meta_cfg: dict):
     """Rebuild an ApexConfig from :func:`config_to_meta` output."""
     from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig,
                                  CommsConfig, EnvConfig, LearnerConfig,
-                                 ReplayConfig)
+                                 R2D2Config, ReplayConfig)
 
     def build(cls, d):
         fields = {f.name for f in dataclasses.fields(cls)}
@@ -188,6 +188,8 @@ def config_from_meta(meta_cfg: dict):
         learner=build(LearnerConfig, meta_cfg["learner"]),
         actor=build(ActorConfig, meta_cfg["actor"]),
         aql=build(AQLConfig, meta_cfg["aql"]),
+        # older checkpoints predate the r2d2 section: default it
+        r2d2=build(R2D2Config, meta_cfg.get("r2d2", {})),
         comms=build(CommsConfig, meta_cfg["comms"]),
     )
 
@@ -216,7 +218,9 @@ def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
     params = raw["train_state"]["params"]
 
     # family dispatch by spec shape: AQL specs carry action_dim (Box
-    # actions), DQN specs carry num_actions (Discrete)
+    # actions), recurrent specs carry lstm_features, DQN specs carry
+    # num_actions only
+    reset_policy = None
     if "action_dim" in spec:
         from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
         model = AQLNetwork(**spec, noisy_deterministic=True)
@@ -225,6 +229,20 @@ def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
         def policy(params, obs, eps, key):
             a, _, _, _ = aql_policy(params, obs, eps, key)
             return np.asarray(a[0])
+    elif "lstm_features" in spec:
+        from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                               make_recurrent_policy_fn)
+        model = RecurrentDuelingDQN(**spec)
+        rec_policy = jax.jit(make_recurrent_policy_fn(model))
+        carry_box = [model.initial_state(1)]
+
+        def policy(params, obs, eps, key):
+            a, _, carry_box[0] = rec_policy(params, obs, carry_box[0],
+                                            eps, key)
+            return int(a[0])
+
+        def reset_policy():       # fresh carry each episode
+            carry_box[0] = model.initial_state(1)
     else:
         from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
         model = DuelingDQN(**spec)
@@ -239,6 +257,8 @@ def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
     rewards = []
     for ep in range(episodes):
         obs, _ = env.reset(seed=seed + ep)
+        if reset_policy is not None:
+            reset_policy()
         total, done, steps = 0.0, False, 0
         while not done and steps < max_steps:
             key, k = jax.random.split(key)

@@ -60,49 +60,15 @@ class LearnerCore:
 
     def update_from_batch(self, train_state: TrainState, batch: Any,
                           weights: jax.Array, axis_name: str | None = None):
-        """The update body shared by every learner variant: loss/grads ->
-        (optional cross-chip pmean) -> clip+RMSprop -> periodic target sync.
-
-        ``axis_name`` is the mesh axis to all-reduce gradients/metrics over
-        (the sharded learner passes ``"dp"``); ``None`` = single chip.  One
-        body, one numerical contract (SURVEY.md §3.3).
-
-        Returns ``(train_state, priorities, metrics)``.
-        """
+        """The update body shared by every single-optimizer learner
+        variant — see :func:`td_update`."""
 
         def loss_fn(params):
             return double_dqn_loss(self.apply_fn, params,
                                    train_state.target_params, batch, weights)
 
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            train_state.params)
-        if axis_name is not None:
-            grads = jax.lax.pmean(grads, axis_name)     # ICI all-reduce
-            loss = jax.lax.pmean(loss, axis_name)
-        updates, opt_state = self.optimizer.update(
-            grads, train_state.opt_state, train_state.params)
-        params = optax.apply_updates(train_state.params, updates)
-
-        step = train_state.step + 1
-        target_params = jax.lax.cond(
-            step % self.target_update_interval == 0,
-            lambda: jax.tree.map(jnp.copy, params),
-            lambda: train_state.target_params)
-
-        q_mean = aux.q_taken.mean()
-        td_mean = aux.td_abs.mean()
-        if axis_name is not None:
-            q_mean = jax.lax.pmean(q_mean, axis_name)
-            td_mean = jax.lax.pmean(td_mean, axis_name)
-        metrics = {
-            "loss": loss,
-            "grad_norm": optax.global_norm(grads),
-            "q_mean": q_mean,
-            "td_mean": td_mean,
-        }
-        train_state = TrainState(params=params, target_params=target_params,
-                                 opt_state=opt_state, step=step)
-        return train_state, aux.priorities, metrics
+        return td_update(self.optimizer, self.target_update_interval,
+                         train_state, loss_fn, axis_name)
 
     def train_step(self, train_state: TrainState, replay_state: ReplayState,
                    key: jax.Array, beta: jax.Array):
@@ -147,6 +113,52 @@ class LearnerCore:
 
     def jit_fused_multi_step(self):
         return jax.jit(self.fused_multi_step, donate_argnums=(0, 1))
+
+
+def td_update(optimizer, target_update_interval: int,
+              train_state: TrainState, loss_fn, axis_name: str | None):
+    """The single-optimizer TD update body: loss/grads -> (optional
+    cross-chip pmean) -> clip+optimizer -> periodic target sync.
+
+    ``loss_fn(params) -> (loss, TDOutput)`` is the only family-specific
+    piece — the DQN core passes the stacked-batch double-DQN loss, the
+    recurrent core the sequence loss.  ``axis_name`` is the mesh axis to
+    all-reduce gradients/metrics over (the sharded learner passes
+    ``"dp"``); ``None`` = single chip.  One body, one numerical contract
+    (SURVEY.md §3.3); AQL's two-optimizer update is the one deliberate
+    exception (:class:`apex_tpu.training.aql.AQLCore`).
+
+    Returns ``(train_state, priorities, metrics)``.
+    """
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        train_state.params)
+    if axis_name is not None:
+        grads = jax.lax.pmean(grads, axis_name)         # ICI all-reduce
+        loss = jax.lax.pmean(loss, axis_name)
+    updates, opt_state = optimizer.update(
+        grads, train_state.opt_state, train_state.params)
+    params = optax.apply_updates(train_state.params, updates)
+
+    step = train_state.step + 1
+    target_params = jax.lax.cond(
+        step % target_update_interval == 0,
+        lambda: jax.tree.map(jnp.copy, params),
+        lambda: train_state.target_params)
+
+    q_mean = aux.q_taken.mean()
+    td_mean = aux.td_abs.mean()
+    if axis_name is not None:
+        q_mean = jax.lax.pmean(q_mean, axis_name)
+        td_mean = jax.lax.pmean(td_mean, axis_name)
+    metrics = {
+        "loss": loss,
+        "grad_norm": optax.global_norm(grads),
+        "q_mean": q_mean,
+        "td_mean": td_mean,
+    }
+    train_state = TrainState(params=params, target_params=target_params,
+                             opt_state=opt_state, step=step)
+    return train_state, aux.priorities, metrics
 
 
 def scan_fused_steps(core, train_state, replay_state, ingest_batches,

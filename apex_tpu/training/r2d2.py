@@ -1,0 +1,388 @@
+"""Recurrent DQN (R2D2-style) driver — the reference's unfinished TODO.
+
+The reference lists "recurrent DQN" as future work (``README.md:5``) and
+ships nothing; this module implements the family end to end, TPU-first:
+
+* **Model**: :class:`apex_tpu.models.recurrent.RecurrentDuelingDQN` —
+  same Nature trunk / dueling heads as the DQN family with an LSTM
+  between them, unrolled by ``lax.scan`` inside one compiled step.
+* **Replay**: sequences ARE replay items.  :class:`DeviceReplay` is
+  generic over item pytrees, so a prioritized SEQUENCE buffer is just
+  items with ``[T, ...]`` leaves (obs/action/reward/discount/mask per
+  step + the stored recurrent state) — no new storage layout, and the
+  fused ingest/sample/update machinery applies unchanged.
+* **Actor side**: :class:`SequenceBuilder` splits episodes into
+  overlapping fixed-length sequences (R2D2's stride = unroll/2) and
+  records the policy's recurrent state at each sequence start (the
+  "stored state" strategy).
+* **Loss**: :func:`apex_tpu.ops.losses.r2d2_loss` — burn-in prefix
+  warms the state gradient-free, then n-step double-DQN over the unroll
+  with per-sequence mixed max/mean priorities.
+
+The long-context story of this framework (SURVEY.md §5.7's n-step
+windows + frame stacking) extends here to genuinely recurrent sequence
+replay: the memory horizon is the LSTM's, not the frame stack's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu.config import ApexConfig
+from apex_tpu.envs.registry import make_env, make_eval_env, num_actions
+from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                       make_recurrent_policy_fn)
+from apex_tpu.ops.losses import make_optimizer, r2d2_loss
+from apex_tpu.replay.base import check_hbm_budget
+from apex_tpu.replay.device import DeviceReplay
+from apex_tpu.training.checkpoint import (CheckpointableTrainer,
+                                          Checkpointer)
+from apex_tpu.training.dqn import BetaSchedule, EpsilonSchedule
+from apex_tpu.training.learner import td_update
+from apex_tpu.training.state import TrainState
+from apex_tpu.utils.metrics import MetricLogger, RateCounter
+from apex_tpu.utils.seeding import set_global_seeds
+
+
+class SequenceBuilder:
+    """Host-side episode-to-sequence splitter (R2D2 overlapping windows).
+
+    Per step the caller provides the observation, action, reward,
+    termination flag, and the policy's recurrent state BEFORE acting (the
+    carry that produced the action).  Episodes are cut into sequences of
+    ``t_total = burn_in + unroll + n_steps`` steps starting every
+    ``stride`` steps; short tails are zero-padded with ``mask=0`` (padded
+    ``discount=0`` also truncates every n-step product crossing the
+    boundary, see :func:`r2d2_loss`).  A sequence is emitted only if its
+    loss region (positions ``burn_in..``) contains at least one real
+    step.
+    """
+
+    def __init__(self, burn_in: int, unroll: int, n_steps: int,
+                 gamma: float, stride: int | None = None):
+        self.burn_in, self.unroll, self.n_steps = burn_in, unroll, n_steps
+        self.t_total = burn_in + unroll + n_steps
+        self.stride = stride or max(1, unroll // 2)
+        self.gamma = gamma
+        self._obs: list = []
+        self._action: list = []
+        self._reward: list = []
+        self._discount: list = []
+        self._carry: list = []
+        self._out: list[dict] = []
+
+    def add_step(self, obs, action: int, reward: float, terminated: bool,
+                 carry_c: np.ndarray, carry_h: np.ndarray) -> None:
+        self._obs.append(np.asarray(obs))
+        self._action.append(int(action))
+        self._reward.append(float(reward))
+        self._discount.append(0.0 if terminated else self.gamma)
+        self._carry.append((np.asarray(carry_c), np.asarray(carry_h)))
+
+    def end_episode(self, truncated: bool = False) -> None:
+        """Cut the finished episode into sequences; clears step buffers.
+
+        ``truncated``: the episode ended by time limit, not termination.
+        Loss positions whose n-step window crosses a TRUNCATION boundary
+        would bootstrap from padded all-zero observations at full weight
+        ``gamma^n`` (a terminated boundary is safe: its ``discount=0``
+        kills the product) — those positions get ``mask=0``, excluding
+        them from the loss entirely.  The DQN family's analogue stores
+        ``final_obs`` and bootstraps truncation-correctly
+        (:mod:`apex_tpu.replay.nstep`); for sequences, dropping the last
+        ``n_steps`` loss positions is the standard unbiased treatment.
+        """
+        n = len(self._obs)
+        if n == 0:
+            return
+        mask_full = np.ones(n, np.float32)
+        if truncated:
+            mask_full[max(0, n - self.n_steps):] = 0.0
+        obs = np.stack(self._obs)
+        start = 0
+        while start + self.burn_in < n:
+            end = min(start + self.t_total, n)
+            pad = self.t_total - (end - start)
+            m = _pad(mask_full[start:end], pad)
+            if not m[self.burn_in:self.burn_in + self.unroll].any():
+                break            # loss region entirely padded/masked
+            c, h = self._carry[start]
+            seq = dict(
+                obs=_pad(obs[start:end], pad),
+                action=_pad(np.asarray(self._action[start:end], np.int32),
+                            pad),
+                reward=_pad(np.asarray(self._reward[start:end], np.float32),
+                            pad),
+                discount=_pad(np.asarray(self._discount[start:end],
+                                         np.float32), pad),
+                mask=m,
+                state_c=c.astype(np.float32),
+                state_h=h.astype(np.float32),
+            )
+            self._out.append(seq)
+            start += self.stride
+        self._obs, self._action, self._reward = [], [], []
+        self._discount, self._carry = [], []
+
+    def drain(self) -> list[dict]:
+        out, self._out = self._out, []
+        return out
+
+
+def _pad(arr: np.ndarray, pad: int) -> np.ndarray:
+    if pad == 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, widths)
+
+
+@dataclass(frozen=True)
+class R2D2Core:
+    """Static wiring of the recurrent model/replay/optimizer into jitted
+    steps — the recurrent sibling of :class:`LearnerCore`/:class:`AQLCore`
+    (same ``ingest``/``train_step`` signature, so
+    :func:`apex_tpu.training.learner.scan_fused_steps` applies)."""
+
+    model: RecurrentDuelingDQN
+    replay: DeviceReplay
+    optimizer: optax.GradientTransformation
+    batch_size: int = 64
+    target_update_interval: int = 2500
+    burn_in: int = 8
+    n_steps: int = 3
+
+    def update_from_batch(self, ts: TrainState, batch, weights,
+                          axis_name: str | None = None):
+        def loss_fn(params):
+            return r2d2_loss(self.model.apply, params, ts.target_params,
+                             batch, weights, burn_in=self.burn_in,
+                             n_steps=self.n_steps)
+
+        return td_update(self.optimizer, self.target_update_interval,
+                         ts, loss_fn, axis_name)
+
+    def train_step(self, ts, rs, key, beta):
+        batch, weights, idx = self.replay.sample(rs, key, self.batch_size,
+                                                 beta)
+        ts, priorities, metrics = self.update_from_batch(ts, batch, weights)
+        rs = self.replay.update_priorities(rs, idx, priorities)
+        return ts, rs, metrics
+
+    def ingest(self, rs, batch, priorities):
+        return self.replay.add(rs, batch, priorities)
+
+    def ingest_max(self, rs, batch):
+        """Max-priority insert (``memory.py:235-240``): sequence priorities
+        need a full unroll to compute, so inserts use the running max and
+        the learner's write-back corrects them — the reference's own
+        insert policy for its non-Custom buffer."""
+        return self.replay.add_max_priority(rs, batch)
+
+    def fused_step(self, ts, rs, ingest_batch, ingest_prios, key, beta):
+        rs = self.ingest(rs, ingest_batch, ingest_prios)
+        return self.train_step(ts, rs, key, beta)
+
+    def jit_train_step(self):
+        return jax.jit(self.train_step, donate_argnums=(0, 1))
+
+    def jit_ingest_max(self):
+        return jax.jit(self.ingest_max, donate_argnums=(0,))
+
+
+class R2D2Trainer(CheckpointableTrainer):
+    """Single-process recurrent driver, mirroring :class:`DQNTrainer`'s
+    loop with a stateful policy: the recurrent carry threads through the
+    episode and resets at boundaries; each env step feeds the
+    SequenceBuilder with the carry that produced the action."""
+
+    def __init__(self, config: ApexConfig | None = None,
+                 logdir: str | None = None, verbose: bool = False,
+                 train_every: int = 4, checkpoint_dir: str | None = None):
+        import dataclasses as _dc
+        cfg = config or ApexConfig()
+        # single frames for the recurrent family: the LSTM is the memory,
+        # a frame stack would quadruple sequence-replay HBM for nothing
+        # (models/recurrent.py module docstring); the replaced cfg is what
+        # checkpoints save, so enjoy/eval rebuild the same env
+        cfg = cfg.replace(env=_dc.replace(cfg.env, frame_stack=1))
+        self.cfg = cfg
+        self.key = set_global_seeds(cfg.env.seed)
+        self.env = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
+                            max_episode_steps=cfg.actor.max_episode_length)
+        obs_shape = self.env.observation_space.shape
+        rc, lc = cfg.r2d2, cfg.learner
+        self.model_spec = dict(
+            num_actions=num_actions(self.env),
+            obs_is_image=len(obs_shape) == 3,
+            compute_dtype=jnp.dtype(lc.compute_dtype),
+            scale_uint8=self.env.observation_space.dtype == np.uint8,
+            lstm_features=rc.lstm_features)
+        self.model = RecurrentDuelingDQN(**self.model_spec)
+
+        t_total = rc.burn_in + rc.unroll + lc.n_steps
+        self.replay = DeviceReplay(capacity=cfg.replay.capacity,
+                                   alpha=cfg.replay.alpha,
+                                   eps=cfg.replay.eps)
+        example_item = dict(
+            obs=jnp.zeros((t_total,) + obs_shape,
+                          self.env.observation_space.dtype),
+            action=jnp.zeros(t_total, jnp.int32),
+            reward=jnp.zeros(t_total, jnp.float32),
+            discount=jnp.zeros(t_total, jnp.float32),
+            mask=jnp.zeros(t_total, jnp.float32),
+            state_c=jnp.zeros(rc.lstm_features, jnp.float32),
+            state_h=jnp.zeros(rc.lstm_features, jnp.float32))
+        check_hbm_budget(self.replay.hbm_bytes(example_item),
+                         cfg.replay.hbm_budget_gb,
+                         "R2D2 replay (sequence storage)",
+                         cfg.replay.capacity)
+        self.replay_state = self.replay.init(example_item)
+
+        optimizer = make_optimizer(
+            lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
+            centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm,
+            lr_decay_steps=lc.lr_decay_steps, lr_decay_rate=lc.lr_decay_rate)
+        self.key, init_key = jax.random.split(self.key)
+        carry0 = self.model.initial_state(1)
+        example_seq = jnp.zeros((1, t_total) + obs_shape,
+                                self.env.observation_space.dtype)
+        params = self.model.init(init_key, example_seq, carry0)
+        self.train_state = TrainState(
+            params=params, target_params=jax.tree.map(jnp.copy, params),
+            opt_state=optimizer.init(params), step=jnp.int32(0))
+        self.core = R2D2Core(model=self.model, replay=self.replay,
+                             optimizer=optimizer,
+                             batch_size=lc.batch_size,
+                             target_update_interval=lc.target_update_interval,
+                             burn_in=rc.burn_in, n_steps=lc.n_steps)
+        self._train_step = self.core.jit_train_step()
+        self._ingest_max = self.core.jit_ingest_max()
+        self._policy = jax.jit(make_recurrent_policy_fn(self.model))
+
+        self.builder = SequenceBuilder(rc.burn_in, rc.unroll, lc.n_steps,
+                                       lc.gamma, stride=rc.stride)
+        self._pending: list[dict] = []
+        self.ingest_group = 4
+        self.train_every = train_every
+        self.epsilon = EpsilonSchedule()
+        self.beta = BetaSchedule(start=cfg.replay.beta)
+        self.log = MetricLogger("learner", logdir, verbose=verbose)
+        self.frames_rate = RateCounter()
+        self.steps_rate = RateCounter()
+        self.sequences = 0
+        self.checkpointer = (Checkpointer(checkpoint_dir)
+                             if checkpoint_dir else None)
+
+    # -- checkpointing (A4) ------------------------------------------------
+
+    def _counters(self) -> dict:
+        return dict(sequences=self.sequences, frames=self.frames_rate.total,
+                    steps=self.steps_rate.total)
+
+    def _apply_counters(self, meta: dict) -> None:
+        self.sequences = meta["sequences"]
+        self.frames_rate.total = meta["frames"]
+        self.steps_rate.total = meta["steps"]
+
+    # -- main loop ---------------------------------------------------------
+
+    def train(self, total_frames: int, log_every: int = 1000,
+              warmup_sequences: int | None = None):
+        cfg = self.cfg
+        warmup = (warmup_sequences if warmup_sequences is not None
+                  else max(2 * cfg.learner.batch_size, 64))
+        obs, _ = self.env.reset(seed=cfg.env.seed)
+        carry = self.model.initial_state(1)
+        episode_reward, episode_len, episode_idx = 0.0, 0, 0
+        start = self.frames_rate.total
+
+        for frame in range(start + 1, start + total_frames + 1):
+            eps = self.epsilon(frame)
+            self.key, act_key = jax.random.split(self.key)
+            obs_np = np.asarray(obs)
+            carry_before = carry
+            actions, _q, carry = self._policy(
+                self.train_state.params, obs_np[None], carry,
+                jnp.float32(eps), act_key)
+            action = int(actions[0])
+
+            next_obs, reward, terminated, truncated, _ = self.env.step(action)
+            self.builder.add_step(obs_np, action, float(reward),
+                                  bool(terminated),
+                                  np.asarray(carry_before[0][0]),
+                                  np.asarray(carry_before[1][0]))
+            obs = next_obs
+            episode_reward += float(reward)
+            episode_len += 1
+            self.frames_rate.tick()
+
+            if terminated or truncated:
+                self.builder.end_episode(
+                    truncated=bool(truncated and not terminated))
+                # grouped fixed-shape ingest: stacks of exactly
+                # ingest_group sequences -> one transfer + one dispatch,
+                # no per-count retrace; remainders wait for the next
+                # episode's drain
+                self._pending.extend(self.builder.drain())
+                g = self.ingest_group
+                while len(self._pending) >= g:
+                    take, self._pending = self._pending[:g], self._pending[g:]
+                    batch = {k: jnp.asarray(np.stack([s[k] for s in take]))
+                             for k in take[0]}
+                    self.replay_state = self._ingest_max(self.replay_state,
+                                                         batch)
+                    self.sequences += g
+                obs, _ = self.env.reset()
+                carry = self.model.initial_state(1)
+                self.log.scalars({"episode_reward": episode_reward,
+                                  "episode_length": episode_len}, episode_idx)
+                episode_reward, episode_len = 0.0, 0
+                episode_idx += 1
+
+            if (self.sequences >= warmup
+                    and frame % self.train_every == 0):
+                self.key, step_key = jax.random.split(self.key)
+                self.train_state, self.replay_state, metrics = \
+                    self._train_step(self.train_state, self.replay_state,
+                                     step_key, jnp.float32(self.beta(frame)))
+                self.steps_rate.tick()
+                if (self.checkpointer is not None and self.steps_rate.total
+                        % cfg.learner.save_interval == 0):
+                    self.save_checkpoint()
+                if self.steps_rate.total % log_every == 0:
+                    self.log.scalars(
+                        {k: float(v) for k, v in metrics.items()}
+                        | {"bps": self.steps_rate.rate,
+                           "fps": self.frames_rate.rate,
+                           "sequences": self.sequences},
+                        self.steps_rate.total)
+        return self
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
+                 max_steps: int = 10_000) -> float:
+        if not hasattr(self, "_eval_env"):
+            self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
+                                           seed=self.cfg.env.seed + 999)
+        rewards = []
+        for ep in range(episodes):
+            obs, _ = self._eval_env.reset(seed=self.cfg.env.seed + 1000 + ep)
+            carry = self.model.initial_state(1)
+            total, done, steps = 0.0, False, 0
+            while not done and steps < max_steps:
+                self.key, k = jax.random.split(self.key)
+                a, _, carry = self._policy(self.train_state.params,
+                                           np.asarray(obs)[None], carry,
+                                           jnp.float32(epsilon), k)
+                obs, r, term, trunc, _ = self._eval_env.step(int(a[0]))
+                total += float(r)
+                done = term or trunc
+                steps += 1
+            rewards.append(total)
+        return float(np.mean(rewards))

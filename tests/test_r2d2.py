@@ -1,0 +1,339 @@
+"""Recurrent (R2D2-style) family: model, loss oracle, sequence builder,
+driver mechanics, and the partially-observable learning certificate."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.config import small_test_config
+from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                       make_recurrent_policy_fn)
+from apex_tpu.ops.losses import r2d2_loss
+from apex_tpu.training.r2d2 import R2D2Trainer, SequenceBuilder
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)
+
+
+# -- model ------------------------------------------------------------------
+
+def test_recurrent_step_matches_unroll(key):
+    """Stepping one frame at a time through the carry must reproduce the
+    full-sequence unroll exactly — the actor/learner consistency contract
+    (actors step, the loss unrolls)."""
+    m = RecurrentDuelingDQN(num_actions=3, obs_is_image=False,
+                            compute_dtype=jnp.float32, scale_uint8=False,
+                            lstm_features=16)
+    carry0 = m.initial_state(2)
+    xs = jax.random.normal(key, (2, 5, 4))
+    params = m.init(jax.random.key(1), xs, carry0)
+    q_seq, carry_end = m.apply(params, xs, carry0)
+    assert q_seq.shape == (2, 5, 3)
+
+    c = carry0
+    qs = []
+    for t in range(5):
+        q1, c = m.apply(params, xs[:, t:t + 1], c)
+        qs.append(q1[:, 0])
+    np.testing.assert_allclose(np.asarray(jnp.stack(qs, 1)),
+                               np.asarray(q_seq), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c[0]), np.asarray(carry_end[0]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_recurrent_image_trunk_and_policy(key):
+    m = RecurrentDuelingDQN(num_actions=4, lstm_features=32)
+    carry = m.initial_state(2)
+    x = jnp.zeros((2, 3, 84, 84, 1), jnp.uint8)
+    params = m.init(key, x, carry)
+    q, _ = m.apply(params, x, carry)
+    assert q.shape == (2, 3, 4) and q.dtype == jnp.float32
+
+    policy = jax.jit(make_recurrent_policy_fn(m))
+    a, qv, c2 = policy(params, x[:, 0], carry, jnp.float32(0.0),
+                       jax.random.key(5))
+    assert a.shape == (2,) and qv.shape == (2, 4)
+    # greedy at epsilon 0
+    np.testing.assert_array_equal(np.asarray(a),
+                                  np.asarray(qv.argmax(axis=1)))
+
+
+# -- loss oracle ------------------------------------------------------------
+
+def test_r2d2_loss_matches_numpy_oracle():
+    """Brute-force oracle over a hand-built q function: n-step returns,
+    discount truncation at terminals, mask handling, per-sequence
+    eta-mixed priorities."""
+    b, burn, unroll, n, a = 3, 2, 4, 2, 3
+    t_total = burn + unroll + n
+    rng = np.random.default_rng(0)
+
+    # a fake recurrent net: q depends only on obs (carry passthrough),
+    # so the oracle can evaluate it without an RNN
+    w_online = rng.normal(size=(5, a)).astype(np.float32)
+    w_target = rng.normal(size=(5, a)).astype(np.float32)
+
+    def apply_fn(params, obs_seq, carry):
+        q = jnp.einsum("btd,da->bta", obs_seq, jnp.asarray(params))
+        return q, carry
+
+    obs = rng.normal(size=(b, t_total, 5)).astype(np.float32)
+    action = rng.integers(0, a, (b, t_total)).astype(np.int32)
+    reward = rng.normal(size=(b, t_total)).astype(np.float32)
+    gamma = 0.9
+    discount = np.full((b, t_total), gamma, np.float32)
+    discount[0, 4] = 0.0                        # a terminal mid-sequence
+    mask = np.ones((b, t_total), np.float32)
+    mask[2, -3:] = 0.0                          # a padded tail
+    discount[2, -3:] = 0.0
+    reward[2, -3:] = 0.0
+    batch = dict(obs=jnp.asarray(obs), action=jnp.asarray(action),
+                 reward=jnp.asarray(reward), discount=jnp.asarray(discount),
+                 mask=jnp.asarray(mask),
+                 state_c=jnp.zeros((b, 1)), state_h=jnp.zeros((b, 1)))
+    weights = jnp.asarray(rng.uniform(0.5, 1.5, b).astype(np.float32))
+
+    loss, out = r2d2_loss(apply_fn, w_online, w_target, batch, weights,
+                          burn_in=burn, n_steps=n)
+
+    # ---- numpy oracle ----
+    q_on = obs @ w_online                       # [b, t, a]
+    q_tg = obs @ w_target
+    eta, eps = 0.9, 1e-6
+    exp_prios, exp_loss_terms, exp_td_means = [], [], []
+    for i in range(b):
+        tds, masks = [], []
+        for t in range(burn, burn + unroll):
+            g, dp = 0.0, 1.0
+            for j in range(n):
+                g += dp * reward[i, t + j]
+                dp *= discount[i, t + j]
+            a_star = int(q_on[i, t + n].argmax())
+            target = g + dp * q_tg[i, t + n, a_star]
+            td = target - q_on[i, t, action[i, t]]
+            tds.append(td)
+            masks.append(mask[i, t])
+        tds, masks = np.array(tds), np.array(masks)
+        nv = max(masks.sum(), 1.0)
+        h = np.where(np.abs(tds) < 1, 0.5 * tds ** 2, np.abs(tds) - 0.5)
+        exp_loss_terms.append((h * masks).sum() / nv * float(weights[i]))
+        abs_m = np.abs(tds) * masks
+        exp_prios.append(eta * abs_m.max() + (1 - eta) * abs_m.sum() / nv
+                         + eps)
+        exp_td_means.append(abs_m.sum() / nv)
+    np.testing.assert_allclose(float(loss), np.mean(exp_loss_terms),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.priorities), exp_prios,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.td_abs), exp_td_means,
+                               rtol=1e-5)
+
+
+def test_r2d2_burn_in_carries_no_gradient():
+    """Gradient w.r.t. params must not flow through the burn-in prefix.
+    With a carry-accumulating fake net (``c += p * o_t``, ``q = [c, -c]``)
+    and geometry burn=1/unroll=1/n=1, the real loss's gradient must equal
+    a closed-form recomputation in which the prefix carry is an explicit
+    ``stop_gradient(p) * o0`` constant — a leaky implementation would add
+    the prefix term ``o0`` to the gradient."""
+    from apex_tpu.ops.losses import huber
+
+    rng = np.random.default_rng(1)
+    o = jnp.asarray(rng.normal(size=3).astype(np.float32))
+    r1, d1 = 0.4, 0.9
+    pt = jnp.float32(0.7)          # target params
+    p0 = jnp.float32(1.3)
+
+    def apply_fn(params, obs_seq, carry):
+        c, h = carry
+        outs = []
+        for t in range(obs_seq.shape[1]):
+            c = c + params * obs_seq[:, t, :1]
+            outs.append(jnp.concatenate([c, -c], axis=1))
+        return jnp.stack(outs, 1), (c, h)
+
+    batch = dict(obs=o.reshape(1, 3, 1),
+                 action=jnp.zeros((1, 3), jnp.int32),
+                 reward=jnp.asarray([[0.0, r1, 0.0]]),
+                 discount=jnp.full((1, 3), d1),
+                 mask=jnp.ones((1, 3)),
+                 state_c=jnp.zeros((1, 1)), state_h=jnp.zeros((1, 1)))
+
+    def loss_real(p):
+        l, _ = r2d2_loss(apply_fn, p, pt, batch, jnp.ones(1),
+                         burn_in=1, n_steps=1)
+        return l
+
+    def loss_manual(p):
+        c0 = jax.lax.stop_gradient(p) * o[0]     # detached prefix carry
+        c1 = c0 + p * o[1]                       # q at t=1 (loss position)
+        c2 = c1 + p * o[2]                       # q at t=2 (bootstrap)
+        ct2 = pt * o[0] + pt * o[1] + pt * o[2]  # target net carry at t=2
+        q2 = jnp.stack([c2, -c2])
+        qt2 = jnp.stack([ct2, -ct2])
+        target = r1 + d1 * qt2[jnp.argmax(q2)]
+        td = jax.lax.stop_gradient(target) - c1  # action 0 -> q_taken = c1
+        return huber(td)
+
+    np.testing.assert_allclose(float(loss_real(p0)),
+                               float(loss_manual(p0)), rtol=1e-5)
+    np.testing.assert_allclose(float(jax.grad(loss_real)(p0)),
+                               float(jax.grad(loss_manual)(p0)), rtol=1e-5)
+    # sanity: the leaky version WOULD differ (prefix term is nonzero)
+    assert abs(float(o[0])) > 1e-3
+
+
+# -- sequence builder -------------------------------------------------------
+
+def test_sequence_builder_segmentation_and_padding():
+    burn, unroll, n, stride = 2, 4, 2, 3
+    t_total = burn + unroll + n
+    b = SequenceBuilder(burn, unroll, n, gamma=0.9, stride=stride)
+    ep_len = 11
+    for t in range(ep_len):
+        b.add_step(np.full(3, t, np.float32), t % 2, float(t),
+                   terminated=(t == ep_len - 1),
+                   carry_c=np.full(4, t, np.float32),
+                   carry_h=np.full(4, -t, np.float32))
+    b.end_episode()
+    seqs = b.drain()
+    # starts at 0, 3, 6, 9; start=9 has 9+burn(2) = 11 >= ep_len -> dropped
+    assert len(seqs) == 3
+    for i, s in enumerate(seqs):
+        start = i * stride
+        real = min(t_total, ep_len - start)
+        np.testing.assert_array_equal(
+            s["mask"], np.pad(np.ones(real), (0, t_total - real)))
+        np.testing.assert_array_equal(s["obs"][:real, 0],
+                                      np.arange(start, start + real))
+        np.testing.assert_array_equal(s["state_c"],
+                                      np.full(4, start, np.float32))
+        # terminal step carries discount 0; padding too
+        d = s["discount"]
+        for t in range(t_total):
+            step = start + t
+            if t >= real or step == ep_len - 1:
+                assert d[t] == 0.0
+            else:
+                assert d[t] == pytest.approx(0.9)
+    assert b.drain() == []
+
+
+def test_sequence_builder_emits_nothing_for_empty_episode():
+    b = SequenceBuilder(2, 4, 2, gamma=0.9)
+    b.end_episode()
+    assert b.drain() == []
+    # an episode no longer than burn_in has an all-padding loss region:
+    # nothing is emitted (a max-priority zero-gradient item would waste
+    # batch slots)
+    for t in range(2):
+        b.add_step(np.zeros(3, np.float32), 0, 0.0, t == 1,
+                   np.zeros(4, np.float32), np.zeros(4, np.float32))
+    b.end_episode()
+    assert b.drain() == []
+
+
+def test_sequence_builder_masks_truncation_boundary():
+    """Loss positions whose n-step window crosses a TRUNCATION boundary
+    must be masked out — they would otherwise bootstrap from padded
+    all-zero observations at weight gamma^n.  Terminated boundaries stay
+    unmasked (discount 0 already truncates the product)."""
+    burn, unroll, n = 2, 4, 2
+    ep_len = 12
+    for truncated in (True, False):
+        b = SequenceBuilder(burn, unroll, n, gamma=0.9, stride=3)
+        for t in range(ep_len):
+            b.add_step(np.zeros(3, np.float32), 0, 1.0,
+                       terminated=(not truncated and t == ep_len - 1),
+                       carry_c=np.zeros(4, np.float32),
+                       carry_h=np.zeros(4, np.float32))
+        b.end_episode(truncated=truncated)
+        seqs = b.drain()
+        assert seqs
+        got_mask = np.zeros(ep_len)
+        for i, s in enumerate(seqs):
+            start = i * 3
+            real = min(burn + unroll + n, ep_len - start)
+            got_mask[start:start + real] = np.maximum(
+                got_mask[start:start + real], s["mask"][:real])
+        if truncated:
+            # the last n real steps are masked in EVERY sequence
+            np.testing.assert_array_equal(got_mask[-n:], 0.0)
+            np.testing.assert_array_equal(got_mask[:ep_len - n], 1.0)
+        else:
+            np.testing.assert_array_equal(got_mask, 1.0)
+
+
+# -- driver -----------------------------------------------------------------
+
+def test_r2d2_trainer_mechanics():
+    """Env loop with stateful policy, sequence ingest, fused train steps,
+    eval — short mechanics run on the PO env."""
+    cfg = small_test_config(capacity=512, batch_size=16,
+                            env_id="ApexCartPolePO-v0")
+    t = R2D2Trainer(cfg)
+    t.train(total_frames=1200, log_every=10 ** 9, warmup_sequences=16)
+    assert t.frames_rate.total == 1200
+    assert t.steps_rate.total > 0
+    assert t.sequences > 10
+    assert t.env.observation_space.shape == (2,)     # velocities hidden
+    assert np.isfinite(t.evaluate(episodes=1, max_steps=100))
+
+
+def test_r2d2_checkpoint_roundtrip(tmp_path):
+    cfg = small_test_config(capacity=512, batch_size=16,
+                            env_id="ApexCartPolePO-v0")
+    t = R2D2Trainer(cfg, checkpoint_dir=str(tmp_path))
+    t.train(total_frames=800, log_every=10 ** 9, warmup_sequences=8)
+    t.save_checkpoint()
+
+    t2 = R2D2Trainer(cfg, checkpoint_dir=str(tmp_path))
+    t2.restore()
+    assert t2.steps_rate.total == t.steps_rate.total
+    assert t2.sequences == t.sequences
+    for a, b in zip(jax.tree.leaves(t.train_state.params),
+                    jax.tree.leaves(t2.train_state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_r2d2_enjoy_from_checkpoint(tmp_path):
+    """evaluate_checkpoint dispatches recurrent specs (lstm_features) to a
+    carry-threading policy — the trainer-free enjoy path works for this
+    family's checkpoints too."""
+    from apex_tpu.training.checkpoint import evaluate_checkpoint
+
+    cfg = small_test_config(capacity=512, batch_size=16,
+                            env_id="ApexCartPolePO-v0")
+    t = R2D2Trainer(cfg, checkpoint_dir=str(tmp_path))
+    t.train(total_frames=600, log_every=10 ** 9, warmup_sequences=8)
+    path = t.save_checkpoint()
+    score = evaluate_checkpoint(path, episodes=1, max_steps=100)
+    assert np.isfinite(score)
+
+
+@pytest.mark.slow
+def test_r2d2_learns_partially_observable_cartpole():
+    """THE recurrence certificate: CartPole with velocities hidden is
+    unsolvable for a memoryless policy beyond short balancing streaks —
+    the LSTM must integrate position history into velocity estimates.
+    Measured at this exact recipe: random ~20/episode, feedforward
+    DQNTrainer ceiling ~42, this trainer ~192 — the 60 threshold sits
+    well above the memoryless ceiling and well below the recurrent
+    result."""
+    cfg = small_test_config(capacity=2048, batch_size=32,
+                            env_id="ApexCartPolePO-v0")
+    cfg = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, lr=5e-4, target_update_interval=200))
+    t = R2D2Trainer(cfg, train_every=2)
+    t.epsilon.decay = 5000.0
+    t.train(total_frames=30_000, log_every=10 ** 9)
+    eps = [v for _, v in t.log.history["learner/episode_reward"]]
+    first, last = float(np.mean(eps[:15])), float(np.mean(eps[-15:]))
+    score = t.evaluate(episodes=5, epsilon=0.0, max_steps=500)
+    assert last > 1.5 * first, f"no training-curve improvement: {first}->{last}"
+    assert score > 60.0, f"eval reward {score} <= 60: recurrence not learning"
