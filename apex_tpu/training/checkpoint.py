@@ -194,6 +194,42 @@ def config_from_meta(meta_cfg: dict):
     )
 
 
+def run_policy_episodes(env, step_fn, key, episodes: int, epsilon: float,
+                        max_steps: int, seed_base: int,
+                        reset_hook=None, render_hook=None) -> list[float]:
+    """The one greedy-eval episode loop (``eval.py:49-87`` semantics)
+    shared by trainer ``evaluate`` methods and
+    :func:`evaluate_checkpoint` — env reset seeding, key splitting, step
+    accounting, and render flushing live here exactly once.
+
+    ``step_fn(obs_batch, epsilon, key) -> action`` hides the family
+    (params binding, recurrent carry); ``reset_hook()`` runs per episode
+    (recurrent policies reset their carry)."""
+    import jax
+    import jax.numpy as jnp
+
+    rewards = []
+    for ep in range(episodes):
+        obs, _ = env.reset(seed=seed_base + ep)
+        if reset_hook is not None:
+            reset_hook()
+        total, done, steps = 0.0, False, 0
+        while not done and steps < max_steps:
+            key, k = jax.random.split(key)
+            a = step_fn(np.asarray(obs)[None], jnp.float32(epsilon), k)
+            obs, r, term, trunc, _ = env.step(a)
+            if render_hook is not None:
+                render_hook(obs)
+            total += float(r)
+            done = term or trunc
+            steps += 1
+        rewards.append(total)
+        flush = getattr(render_hook, "flush_episode", None)
+        if flush is not None:      # save-mode hooks write one file/episode
+            flush()
+    return rewards
+
+
 # -- eval-from-checkpoint (the reference's `enjoy` role) -------------------
 
 def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
@@ -253,26 +289,9 @@ def evaluate_checkpoint(path: str, episodes: int = 10, epsilon: float = 0.0,
             return int(a[0])
 
     env = make_eval_env(cfg.env.env_id, cfg.env, seed=seed)
-    key = jax.random.key(seed)
-    rewards = []
-    for ep in range(episodes):
-        obs, _ = env.reset(seed=seed + ep)
-        if reset_policy is not None:
-            reset_policy()
-        total, done, steps = 0.0, False, 0
-        while not done and steps < max_steps:
-            key, k = jax.random.split(key)
-            a = policy(params, np.asarray(obs)[None], jnp.float32(epsilon),
-                       k)
-            obs, r, term, trunc, _ = env.step(a)
-            if render_hook is not None:
-                render_hook(obs)
-            total += float(r)
-            done = term or trunc
-            steps += 1
-        rewards.append(total)
-        flush = getattr(render_hook, "flush_episode", None)
-        if flush is not None:      # save-mode hooks write one file/episode
-            flush()
+    rewards = run_policy_episodes(
+        env, lambda obs, eps, k: policy(params, obs, eps, k),
+        jax.random.key(seed), episodes, epsilon, max_steps,
+        seed_base=seed, reset_hook=reset_policy, render_hook=render_hook)
     env.close()
     return float(np.mean(rewards))

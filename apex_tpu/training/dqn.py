@@ -215,21 +215,16 @@ class DQNTrainer(CheckpointableTrainer):
                  max_steps: int = 10_000) -> float:
         """True-score evaluation on a dedicated unclipped/full-episode env
         (reference: eval.py:52 evaluates on the unclipped env)."""
+        from apex_tpu.training.checkpoint import run_policy_episodes
+
         if not hasattr(self, "_eval_env"):
             self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
                                            seed=self.cfg.env.seed + 999)
-        rewards = []
-        for ep in range(episodes):
-            obs, _ = self._eval_env.reset(seed=self.cfg.env.seed + 1000 + ep)
-            total, done, steps = 0.0, False, 0
-            while not done and steps < max_steps:
-                self.key, k = jax.random.split(self.key)
-                a, _ = self._policy(self.train_state.params,
-                                    np.asarray(obs)[None],
-                                    jnp.float32(epsilon), k)
-                obs, r, term, trunc, _ = self._eval_env.step(int(a[0]))
-                total += float(r)
-                done = term or trunc
-                steps += 1
-            rewards.append(total)
+        self.key, eval_key = jax.random.split(self.key)
+        rewards = run_policy_episodes(
+            self._eval_env,
+            lambda obs, eps, k: int(self._policy(
+                self.train_state.params, obs, eps, k)[0][0]),
+            eval_key, episodes, epsilon, max_steps,
+            seed_base=self.cfg.env.seed + 1000)
         return float(np.mean(rewards))

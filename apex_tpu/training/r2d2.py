@@ -76,13 +76,29 @@ class SequenceBuilder:
         self._carry: list = []
         self._out: list[dict] = []
 
+    @property
+    def needs_carry(self) -> bool:
+        """True when the NEXT ``add_step`` starts a sequence window (a
+        stride boundary): only those carries are ever read back, so the
+        caller can skip the device->host carry transfer everywhere else
+        (two blocking syncs per frame otherwise)."""
+        return len(self._obs) % self.stride == 0
+
     def add_step(self, obs, action: int, reward: float, terminated: bool,
-                 carry_c: np.ndarray, carry_h: np.ndarray) -> None:
+                 carry_c: np.ndarray | None,
+                 carry_h: np.ndarray | None) -> None:
+        """``carry_c``/``carry_h`` may be None except when
+        :attr:`needs_carry` was True before this call."""
+        if len(self._obs) % self.stride == 0 and carry_c is None:
+            raise ValueError("sequence-start step needs its carry "
+                             "(check builder.needs_carry before acting)")
         self._obs.append(np.asarray(obs))
         self._action.append(int(action))
         self._reward.append(float(reward))
         self._discount.append(0.0 if terminated else self.gamma)
-        self._carry.append((np.asarray(carry_c), np.asarray(carry_h)))
+        self._carry.append(
+            None if carry_c is None
+            else (np.asarray(carry_c), np.asarray(carry_h)))
 
     def end_episode(self, truncated: bool = False) -> None:
         """Cut the finished episode into sequences; clears step buffers.
@@ -294,8 +310,12 @@ class R2D2Trainer(CheckpointableTrainer):
     def train(self, total_frames: int, log_every: int = 1000,
               warmup_sequences: int | None = None):
         cfg = self.cfg
+        # the configured warmup gate (cfg.replay.warmup, in TRANSITIONS —
+        # same knob every other trainer honors) converted to sequences,
+        # floored at one full batch so early sampling isn't all-duplicates
         warmup = (warmup_sequences if warmup_sequences is not None
-                  else max(2 * cfg.learner.batch_size, 64))
+                  else max(cfg.learner.batch_size,
+                           cfg.replay.warmup // self.builder.t_total))
         obs, _ = self.env.reset(seed=cfg.env.seed)
         carry = self.model.initial_state(1)
         episode_reward, episode_len, episode_idx = 0.0, 0, 0
@@ -305,7 +325,14 @@ class R2D2Trainer(CheckpointableTrainer):
             eps = self.epsilon(frame)
             self.key, act_key = jax.random.split(self.key)
             obs_np = np.asarray(obs)
-            carry_before = carry
+            # materialize the pre-action carry only at sequence starts —
+            # the builder reads nothing else, and each np.asarray is a
+            # blocking device sync
+            if self.builder.needs_carry:
+                cc = np.asarray(carry[0][0])
+                ch = np.asarray(carry[1][0])
+            else:
+                cc = ch = None
             actions, _q, carry = self._policy(
                 self.train_state.params, obs_np[None], carry,
                 jnp.float32(eps), act_key)
@@ -313,9 +340,7 @@ class R2D2Trainer(CheckpointableTrainer):
 
             next_obs, reward, terminated, truncated, _ = self.env.step(action)
             self.builder.add_step(obs_np, action, float(reward),
-                                  bool(terminated),
-                                  np.asarray(carry_before[0][0]),
-                                  np.asarray(carry_before[1][0]))
+                                  bool(terminated), cc, ch)
             obs = next_obs
             episode_reward += float(reward)
             episode_len += 1
@@ -367,22 +392,22 @@ class R2D2Trainer(CheckpointableTrainer):
 
     def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
                  max_steps: int = 10_000) -> float:
+        from apex_tpu.training.checkpoint import run_policy_episodes
+
         if not hasattr(self, "_eval_env"):
             self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
                                            seed=self.cfg.env.seed + 999)
-        rewards = []
-        for ep in range(episodes):
-            obs, _ = self._eval_env.reset(seed=self.cfg.env.seed + 1000 + ep)
-            carry = self.model.initial_state(1)
-            total, done, steps = 0.0, False, 0
-            while not done and steps < max_steps:
-                self.key, k = jax.random.split(self.key)
-                a, _, carry = self._policy(self.train_state.params,
-                                           np.asarray(obs)[None], carry,
-                                           jnp.float32(epsilon), k)
-                obs, r, term, trunc, _ = self._eval_env.step(int(a[0]))
-                total += float(r)
-                done = term or trunc
-                steps += 1
-            rewards.append(total)
+        carry_box = [self.model.initial_state(1)]
+
+        def step_fn(obs, eps, k):
+            a, _, carry_box[0] = self._policy(self.train_state.params, obs,
+                                              carry_box[0], eps, k)
+            return int(a[0])
+
+        self.key, eval_key = jax.random.split(self.key)
+        rewards = run_policy_episodes(
+            self._eval_env, step_fn, eval_key, episodes, epsilon, max_steps,
+            seed_base=self.cfg.env.seed + 1000,
+            reset_hook=lambda: carry_box.__setitem__(
+                0, self.model.initial_state(1)))
         return float(np.mean(rewards))

@@ -61,6 +61,66 @@ def test_sharded_fused_step_runs_and_replicates(key):
     assert p.sharding.is_fully_replicated
 
 
+def test_sharded_r2d2_fused_step_runs_and_replicates(key):
+    """The recurrent family on the dp mesh: sequence replay shards + the
+    same pmean plan — ShardedLearner is duck-typed over cores, and
+    R2D2Core's update signature matches the single-optimizer shape."""
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.models.recurrent import RecurrentDuelingDQN
+    from apex_tpu.replay.device import DeviceReplay
+    from apex_tpu.training.r2d2 import R2D2Core
+    from apex_tpu.training.state import TrainState
+
+    mesh = make_mesh()
+    burn, unroll, n, t_total, h = 2, 4, 2, 8, 8
+    model = RecurrentDuelingDQN(num_actions=3, obs_is_image=False,
+                                compute_dtype=jnp.float32,
+                                scale_uint8=False, lstm_features=h)
+    optimizer = make_optimizer(lr=1e-3)
+    carry0 = model.initial_state(1)
+    params = model.init(key, jnp.zeros((1, t_total, 5)), carry0)
+    ts = TrainState(params=params,
+                    target_params=jax.tree.map(jnp.copy, params),
+                    opt_state=optimizer.init(params), step=jnp.int32(0))
+    replay = DeviceReplay(capacity=64)
+    core = R2D2Core(model=model, replay=replay, optimizer=optimizer,
+                    batch_size=16, target_update_interval=4,
+                    burn_in=burn, n_steps=n)
+    sl = ShardedLearner(core, mesh)
+    example_item = dict(
+        obs=jnp.zeros((t_total, 5)), action=jnp.zeros(t_total, jnp.int32),
+        reward=jnp.zeros(t_total), discount=jnp.zeros(t_total),
+        mask=jnp.zeros(t_total),
+        state_c=jnp.zeros(h), state_h=jnp.zeros(h))
+    rs = sl.init_replay(example_item)
+    ts = sl.replicate_train_state(ts)
+
+    step = sl.make_fused_step()
+    rng = np.random.default_rng(3)
+
+    def seq_chunk(k):
+        return dict(
+            obs=rng.normal(size=(k, t_total, 5)).astype(np.float32),
+            action=rng.integers(0, 3, (k, t_total)).astype(np.int32),
+            reward=rng.normal(size=(k, t_total)).astype(np.float32),
+            discount=np.full((k, t_total), 0.97, np.float32),
+            mask=np.ones((k, t_total), np.float32),
+            state_c=np.zeros((k, h), np.float32),
+            state_h=np.zeros((k, h), np.float32))
+
+    for i in range(3):
+        ingest, prios = sl.split_ingest(seq_chunk(16),
+                                        np.ones(16, np.float32))
+        ts, rs, metrics = step(ts, rs, ingest, prios,
+                               sl.device_keys(jax.random.key(i)),
+                               jnp.float32(0.4))
+
+    assert int(ts.step) == 3
+    assert np.isfinite(float(metrics["loss"]))
+    np.testing.assert_array_equal(np.asarray(rs.size), np.full(8, 6))
+    assert jax.tree.leaves(ts.params)[0].sharding.is_fully_replicated
+
+
 def test_split_ingest_round_robin():
     mesh = make_mesh()
     core_dummy = None  # split_ingest only uses n_dp
