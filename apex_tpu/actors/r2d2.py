@@ -1,0 +1,103 @@
+"""Recurrent (R2D2) actor worker family.
+
+Plugs stateful acting into the family-agnostic
+:func:`apex_tpu.actors.pool.worker_loop` — same continuous exploration,
+conflating param queues, bounded chunk backpressure, and epsilon ladder as
+the DQN/AQL families.  What's different here is WHAT ships: overlapping
+fixed-length sequences with the policy's stored recurrent state at each
+sequence start and acting-time insert priorities
+(:class:`apex_tpu.training.r2d2.SequenceBuilder`), grouped ``group``
+sequences per message so every message has one fixed shape (no learner
+retrace).
+
+The recurrent carry is worker-local state: it threads through the episode,
+resets at boundaries, and only its stride-aligned snapshots cross to the
+host (the builder's ``needs_carry`` gate).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig
+
+
+def sequence_message(seqs: list[dict]) -> dict:
+    """Stack ``group`` drained sequences into one fixed-shape pool message.
+    ``n_trans`` counts REAL steps (mask sum) so the learner's
+    transition-denominated warmup/ratio gates stay meaningful."""
+    prios = np.stack([s.pop("priority") for s in seqs])
+    payload = {k: np.stack([s[k] for s in seqs]) for k in seqs[0]}
+    return {"payload": payload, "priorities": prios,
+            "n_trans": int(sum(int(s["mask"].sum()) for s in seqs))}
+
+
+class R2D2WorkerFamily:
+    """Recurrent acting/recording hooks for ``worker_loop``."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seed: int,
+                 group: int):
+        import jax
+
+        from apex_tpu.envs.registry import make_env
+        from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                               make_recurrent_policy_fn)
+        from apex_tpu.training.r2d2 import SequenceBuilder
+
+        self.seed = seed
+        self.env = make_env(cfg.env.env_id, cfg.env, seed=seed,
+                            max_episode_steps=cfg.actor.max_episode_length)
+        self.model = RecurrentDuelingDQN(**model_spec)
+        self.policy = jax.jit(make_recurrent_policy_fn(self.model))
+        rc = cfg.r2d2
+        self.builder = SequenceBuilder(rc.burn_in, rc.unroll,
+                                       cfg.learner.n_steps,
+                                       cfg.learner.gamma, stride=rc.stride)
+        self.group = group
+        self.carry = self.model.initial_state(1)
+        self._ready: list[dict] = []
+
+    def begin_episode(self, obs) -> None:
+        self.carry = self.model.initial_state(1)
+
+    def step(self, params, obs, epsilon: float, key):
+        import jax.numpy as jnp
+        obs_np = np.asarray(obs)
+        if self.builder.needs_carry:
+            cc = np.asarray(self.carry[0][0])
+            ch = np.asarray(self.carry[1][0])
+        else:
+            cc = ch = None
+        actions, q, self.carry = self.policy(params, obs_np[None],
+                                             self.carry,
+                                             jnp.float32(epsilon), key)
+        action = int(actions[0])
+        next_obs, reward, term, trunc, _ = self.env.step(action)
+        self.builder.add_step(obs_np, action, float(reward), bool(term),
+                              cc, ch, q_values=np.asarray(q[0]))
+        if term or trunc:
+            self.builder.end_episode(truncated=bool(trunc and not term))
+            self._ready.extend(self.builder.drain())
+        return next_obs, float(reward), bool(term), bool(trunc)
+
+    def poll_msgs(self) -> list[dict]:
+        out = []
+        while len(self._ready) >= self.group:
+            take = self._ready[:self.group]
+            self._ready = self._ready[self.group:]
+            out.append(sequence_message(take))
+        return out
+
+
+def r2d2_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
+                     chunk_queue, param_queue, stat_queue, stop_event,
+                     epsilon: float, chunk_transitions: int) -> None:
+    """R2D2 worker process body; ``chunk_transitions`` is reused as the
+    sequence GROUP per message (the pool passes it through verbatim)."""
+    from apex_tpu.actors.pool import worker_loop
+
+    family = R2D2WorkerFamily(cfg, model_spec,
+                              seed=cfg.env.seed + 1000 * (actor_id + 1),
+                              group=chunk_transitions)
+    worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
+                stop_event, epsilon)

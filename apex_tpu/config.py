@@ -146,6 +146,11 @@ class R2D2Config:
     stride: int | None = None
     lstm_features: int = 128    # recurrent width (reference head scale;
                                 # R2D2 itself uses 512 — raise for Atari)
+    # sequences per ingest batch / pool message — ONE constant shared by
+    # the single-process driver, the concurrent trainer, and the socket
+    # actor role so every message has the same fixed shape (the scan
+    # dispatch and shm slot sizing both assume it)
+    sequence_group: int = 4
 
 
 @dataclass(frozen=True)

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "single-host drivers: dqn/aql/r2d2/apex; "
                         "enjoy: eval a checkpoint")
     p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
-                   choices=["dqn", "aql"])
+                   choices=["dqn", "aql", "r2d2"])
     # env
     p.add_argument("--env-id", default=e.get("APEX_ENV_ID",
                                              "SeaquestNoFrameskip-v4"))
@@ -225,6 +225,9 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
             if args.family == "aql":
                 from apex_tpu.training.aql import \
                     AQLApexTrainer as trainer_cls
+            elif args.family == "r2d2":
+                from apex_tpu.training.r2d2 import \
+                    R2D2ApexTrainer as trainer_cls
             else:
                 from apex_tpu.training.apex import \
                     ApexTrainer as trainer_cls

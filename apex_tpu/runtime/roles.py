@@ -110,6 +110,13 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
                                      train_ratio=train_ratio,
                                      min_train_ratio=min_train_ratio,
                                      pool=pool)
+        elif family == "r2d2":
+            from apex_tpu.training.r2d2 import R2D2ApexTrainer
+            trainer = R2D2ApexTrainer(cfg, logdir=logdir, verbose=verbose,
+                                      checkpoint_dir=checkpoint_dir,
+                                      train_ratio=train_ratio,
+                                      min_train_ratio=min_train_ratio,
+                                      pool=pool)
         else:
             raise ValueError(f"unknown family {family!r}")
         if restore:
@@ -158,6 +165,7 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
                          cfg.actor.eps_alpha)[identity.actor_id]
 
     sender = transport.ChunkSender(comms, name)
+    chunk_arg = cfg.actor.send_interval
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
         worker_fn, model_spec = _worker_main, dqn_model_spec(cfg)
@@ -182,13 +190,24 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
             worker_fn = vector_aql_worker_main
             cfg = cfg.replace(actor=dataclasses.replace(
                 cfg.actor, n_actors=identity.n_actors))
+    elif family == "r2d2":
+        from apex_tpu.actors.r2d2 import r2d2_worker_main
+        from apex_tpu.training.r2d2 import r2d2_model_spec
+        if cfg.actor.n_envs_per_actor > 1:
+            raise ValueError("vectorized R2D2 actors are not implemented")
+        model_spec = r2d2_model_spec(cfg)
+        # single frames (the LSTM is the memory); the sequence group per
+        # message is the one shared cfg.r2d2 constant, so actor messages
+        # and the learner's expected shapes can't drift
+        cfg = cfg.replace(env=dataclasses.replace(cfg.env, frame_stack=1))
+        worker_fn, chunk_arg = r2d2_worker_main, cfg.r2d2.sequence_group
     else:
         raise ValueError(f"unknown family {family!r}")
     try:
         worker_fn(identity.actor_id, cfg, model_spec,
                   _ChunkQueueAdapter(sender, stop_event),
                   _ParamQueueAdapter(sub), _StatQueueAdapter(sender),
-                  stop_event, float(eps), cfg.actor.send_interval)
+                  stop_event, float(eps), chunk_arg)
     finally:
         sender.close()
         sub.close()
@@ -209,6 +228,8 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
 
     stop_event = stop_event or threading.Event()
     identity = identity or RoleIdentity(role="evaluator")
+    if family == "r2d2":        # single frames: the LSTM is the memory
+        cfg = cfg.replace(env=dataclasses.replace(cfg.env, frame_stack=1))
     # unique per-evaluator socket/barrier identity: duplicate identities
     # dedup at the barrier (deadlock) and misroute on the ROUTER.  The
     # random suffix makes N default-launched evaluators safe — unlike
@@ -258,8 +279,25 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
         def act(params, obs, key):
             a, _, _, _ = policy(params, obs[None], jnp.float32(0.0), key)
             return np.asarray(a[0])
+    elif family == "r2d2":
+        from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                               make_recurrent_policy_fn)
+        from apex_tpu.training.r2d2 import r2d2_model_spec
+        model = RecurrentDuelingDQN(**r2d2_model_spec(cfg))
+        policy = jax.jit(make_recurrent_policy_fn(model))
+        carry_box = [model.initial_state(1)]
+
+        def act(params, obs, key):
+            a, _, carry_box[0] = policy(params, obs[None], carry_box[0],
+                                        jnp.float32(0.0), key)
+            return int(a[0])
+
+        def reset_act():
+            carry_box[0] = model.initial_state(1)
     else:
         raise ValueError(f"unknown family {family!r}")
+    if family != "r2d2":
+        reset_act = None
 
     got = sub.wait_first(stop_event)
     if got is None:
@@ -270,6 +308,8 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
     ep = 0
     while not stop_event.is_set() and (episodes <= 0 or ep < episodes):
         obs, _ = env.reset()
+        if reset_act is not None:       # recurrent: fresh carry per episode
+            reset_act()
         total, done, steps = 0.0, False, 0
         while not done and steps < max_steps and not stop_event.is_set():
             key, k = jax.random.split(key)

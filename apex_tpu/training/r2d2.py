@@ -40,10 +40,11 @@ from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
 from apex_tpu.ops.losses import make_optimizer, r2d2_loss
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.device import DeviceReplay
+from apex_tpu.training.apex import ConcurrentTrainer
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
                                           Checkpointer)
 from apex_tpu.training.dqn import BetaSchedule, EpsilonSchedule
-from apex_tpu.training.learner import td_update
+from apex_tpu.training.learner import scan_fused_steps, td_update
 from apex_tpu.training.state import TrainState
 from apex_tpu.utils.metrics import MetricLogger, RateCounter
 from apex_tpu.utils.seeding import set_global_seeds
@@ -74,6 +75,7 @@ class SequenceBuilder:
         self._reward: list = []
         self._discount: list = []
         self._carry: list = []
+        self._q: list = []
         self._out: list[dict] = []
 
     @property
@@ -85,10 +87,12 @@ class SequenceBuilder:
         return len(self._obs) % self.stride == 0
 
     def add_step(self, obs, action: int, reward: float, terminated: bool,
-                 carry_c: np.ndarray | None,
-                 carry_h: np.ndarray | None) -> None:
+                 carry_c: np.ndarray | None, carry_h: np.ndarray | None,
+                 q_values: np.ndarray | None = None) -> None:
         """``carry_c``/``carry_h`` may be None except when
-        :attr:`needs_carry` was True before this call."""
+        :attr:`needs_carry` was True before this call.  ``q_values`` (the
+        acting-time Q vector) feeds the insert-priority heuristic; omit it
+        and sequences insert at priority 1."""
         if len(self._obs) % self.stride == 0 and carry_c is None:
             raise ValueError("sequence-start step needs its carry "
                              "(check builder.needs_carry before acting)")
@@ -99,6 +103,8 @@ class SequenceBuilder:
         self._carry.append(
             None if carry_c is None
             else (np.asarray(carry_c), np.asarray(carry_h)))
+        self._q.append(None if q_values is None
+                       else np.asarray(q_values, np.float32))
 
     def end_episode(self, truncated: bool = False) -> None:
         """Cut the finished episode into sequences; clears step buffers.
@@ -119,13 +125,15 @@ class SequenceBuilder:
         mask_full = np.ones(n, np.float32)
         if truncated:
             mask_full[max(0, n - self.n_steps):] = 0.0
+        td_full = self._acting_time_tds(n)
         obs = np.stack(self._obs)
         start = 0
         while start + self.burn_in < n:
             end = min(start + self.t_total, n)
             pad = self.t_total - (end - start)
             m = _pad(mask_full[start:end], pad)
-            if not m[self.burn_in:self.burn_in + self.unroll].any():
+            lm = m[self.burn_in:self.burn_in + self.unroll]
+            if not lm.any():
                 break            # loss region entirely padded/masked
             c, h = self._carry[start]
             seq = dict(
@@ -140,10 +148,36 @@ class SequenceBuilder:
                 state_c=c.astype(np.float32),
                 state_h=h.astype(np.float32),
             )
+            if td_full is not None:
+                td = _pad(td_full[start:end], pad)[
+                    self.burn_in:self.burn_in + self.unroll] * lm
+                nv = max(lm.sum(), 1.0)
+                seq["priority"] = np.float32(
+                    0.9 * td.max() + 0.1 * td.sum() / nv + 1e-6)
+            else:
+                seq["priority"] = np.float32(1.0)
             self._out.append(seq)
             start += self.stride
         self._obs, self._action, self._reward = [], [], []
-        self._discount, self._carry = [], []
+        self._discount, self._carry, self._q = [], [], []
+
+    def _acting_time_tds(self, n: int) -> np.ndarray | None:
+        """Per-step 1-step |TD| from the acting-time Q vectors — the
+        sequence analogue of the DQN actors' priorities-without-rerunning
+        (``memory.py:451-464``): ``|r + disc * max q' - q[a]|``, bootstrap
+        0 past the episode end.  The learner's unrolled n-step write-back
+        replaces these after the first sample; they only order the replay
+        until then.  None when any step lacked its Q vector."""
+        if any(q is None for q in self._q):
+            return None
+        maxq = np.asarray([float(q.max()) for q in self._q] + [0.0],
+                          np.float32)
+        td = np.empty(n, np.float32)
+        for t in range(n):
+            td[t] = abs(self._reward[t]
+                        + self._discount[t] * maxq[t + 1]
+                        - float(self._q[t][self._action[t]]))
+        return td
 
     def drain(self) -> list[dict]:
         out, self._out = self._out, []
@@ -192,22 +226,120 @@ class R2D2Core:
     def ingest(self, rs, batch, priorities):
         return self.replay.add(rs, batch, priorities)
 
-    def ingest_max(self, rs, batch):
-        """Max-priority insert (``memory.py:235-240``): sequence priorities
-        need a full unroll to compute, so inserts use the running max and
-        the learner's write-back corrects them — the reference's own
-        insert policy for its non-Custom buffer."""
-        return self.replay.add_max_priority(rs, batch)
-
     def fused_step(self, ts, rs, ingest_batch, ingest_prios, key, beta):
         rs = self.ingest(rs, ingest_batch, ingest_prios)
         return self.train_step(ts, rs, key, beta)
 
+    def fused_multi_step(self, ts, rs, ingest_batches, ingest_prios, keys,
+                         beta):
+        """K fused steps in one dispatch — see
+        :func:`apex_tpu.training.learner.scan_fused_steps`."""
+        return scan_fused_steps(self, ts, rs, ingest_batches, ingest_prios,
+                                keys, beta)
+
     def jit_train_step(self):
         return jax.jit(self.train_step, donate_argnums=(0, 1))
 
-    def jit_ingest_max(self):
-        return jax.jit(self.ingest_max, donate_argnums=(0,))
+    def jit_ingest(self):
+        return jax.jit(self.ingest, donate_argnums=(0,))
+
+    def jit_fused_step(self):
+        return jax.jit(self.fused_step, donate_argnums=(0, 1))
+
+    def jit_fused_multi_step(self):
+        return jax.jit(self.fused_multi_step, donate_argnums=(0, 1))
+
+
+def r2d2_env_specs(cfg: ApexConfig):
+    """(model_spec, obs_shape, obs_dtype) for the recurrent family —
+    single-frame observations (the LSTM is the memory).  Shared by the
+    drivers and the socket roles."""
+    import dataclasses as _dc
+
+    cfg1 = cfg.replace(env=_dc.replace(cfg.env, frame_stack=1))
+    probe = make_env(cfg1.env.env_id, cfg1.env, seed=cfg1.env.seed)
+    obs_shape = probe.observation_space.shape
+    obs_dtype = probe.observation_space.dtype
+    spec = dict(
+        num_actions=num_actions(probe),
+        obs_is_image=len(obs_shape) == 3,
+        compute_dtype=jnp.dtype(cfg.learner.compute_dtype),
+        scale_uint8=obs_dtype == np.dtype(np.uint8),
+        lstm_features=cfg.r2d2.lstm_features)
+    probe.close()
+    return spec, obs_shape, obs_dtype
+
+
+def r2d2_model_spec(cfg: ApexConfig) -> dict:
+    return r2d2_env_specs(cfg)[0]
+
+
+def build_r2d2(cfg: ApexConfig, key: jax.Array):
+    """(model_spec, obs_shape, obs_dtype, model, replay, replay_state,
+    train_state, core) — THE one definition of the family's replay item
+    schema and core wiring, shared by the single-process and concurrent
+    drivers (two hand-kept copies would let checkpoint bundles and replay
+    layouts silently diverge between them)."""
+    rc, lc = cfg.r2d2, cfg.learner
+    model_spec, obs_shape, obs_dtype = r2d2_env_specs(cfg)
+    model = RecurrentDuelingDQN(**model_spec)
+
+    t_total = rc.burn_in + rc.unroll + lc.n_steps
+    replay = DeviceReplay(capacity=cfg.replay.capacity,
+                          alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+    example_item = dict(
+        obs=jnp.zeros((t_total,) + obs_shape, obs_dtype),
+        action=jnp.zeros(t_total, jnp.int32),
+        reward=jnp.zeros(t_total, jnp.float32),
+        discount=jnp.zeros(t_total, jnp.float32),
+        mask=jnp.zeros(t_total, jnp.float32),
+        state_c=jnp.zeros(rc.lstm_features, jnp.float32),
+        state_h=jnp.zeros(rc.lstm_features, jnp.float32))
+    check_hbm_budget(replay.hbm_bytes(example_item),
+                     cfg.replay.hbm_budget_gb,
+                     "R2D2 replay (sequence storage)", cfg.replay.capacity)
+    replay_state = replay.init(example_item)
+
+    optimizer = make_optimizer(
+        lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
+        centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm,
+        lr_decay_steps=lc.lr_decay_steps, lr_decay_rate=lc.lr_decay_rate)
+    params = model.init(key, jnp.zeros((1, t_total) + obs_shape, obs_dtype),
+                        model.initial_state(1))
+    train_state = TrainState(
+        params=params, target_params=jax.tree.map(jnp.copy, params),
+        opt_state=optimizer.init(params), step=jnp.int32(0))
+    core = R2D2Core(model=model, replay=replay, optimizer=optimizer,
+                    batch_size=lc.batch_size,
+                    target_update_interval=lc.target_update_interval,
+                    burn_in=rc.burn_in, n_steps=lc.n_steps)
+    return (model_spec, obs_shape, obs_dtype, model, replay, replay_state,
+            train_state, core)
+
+
+def _r2d2_evaluate(self, episodes: int = 10, epsilon: float = 0.0,
+                   max_steps: int = 10_000) -> float:
+    """Greedy recurrent eval shared by both R2D2 drivers: the carry
+    threads within each episode and resets between them."""
+    from apex_tpu.training.checkpoint import run_policy_episodes
+
+    if not hasattr(self, "_eval_env"):
+        self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
+                                       seed=self.cfg.env.seed + 999)
+    carry_box = [self.model.initial_state(1)]
+
+    def step_fn(obs, eps, k):
+        a, _, carry_box[0] = self._policy(self.train_state.params, obs,
+                                          carry_box[0], eps, k)
+        return int(a[0])
+
+    self.key, eval_key = jax.random.split(self.key)
+    rewards = run_policy_episodes(
+        self._eval_env, step_fn, eval_key, episodes, epsilon, max_steps,
+        seed_base=self.cfg.env.seed + 1000,
+        reset_hook=lambda: carry_box.__setitem__(
+            0, self.model.initial_state(1)))
+    return float(np.mean(rewards))
 
 
 class R2D2Trainer(CheckpointableTrainer):
@@ -230,60 +362,19 @@ class R2D2Trainer(CheckpointableTrainer):
         self.key = set_global_seeds(cfg.env.seed)
         self.env = make_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed,
                             max_episode_steps=cfg.actor.max_episode_length)
-        obs_shape = self.env.observation_space.shape
         rc, lc = cfg.r2d2, cfg.learner
-        self.model_spec = dict(
-            num_actions=num_actions(self.env),
-            obs_is_image=len(obs_shape) == 3,
-            compute_dtype=jnp.dtype(lc.compute_dtype),
-            scale_uint8=self.env.observation_space.dtype == np.uint8,
-            lstm_features=rc.lstm_features)
-        self.model = RecurrentDuelingDQN(**self.model_spec)
-
-        t_total = rc.burn_in + rc.unroll + lc.n_steps
-        self.replay = DeviceReplay(capacity=cfg.replay.capacity,
-                                   alpha=cfg.replay.alpha,
-                                   eps=cfg.replay.eps)
-        example_item = dict(
-            obs=jnp.zeros((t_total,) + obs_shape,
-                          self.env.observation_space.dtype),
-            action=jnp.zeros(t_total, jnp.int32),
-            reward=jnp.zeros(t_total, jnp.float32),
-            discount=jnp.zeros(t_total, jnp.float32),
-            mask=jnp.zeros(t_total, jnp.float32),
-            state_c=jnp.zeros(rc.lstm_features, jnp.float32),
-            state_h=jnp.zeros(rc.lstm_features, jnp.float32))
-        check_hbm_budget(self.replay.hbm_bytes(example_item),
-                         cfg.replay.hbm_budget_gb,
-                         "R2D2 replay (sequence storage)",
-                         cfg.replay.capacity)
-        self.replay_state = self.replay.init(example_item)
-
-        optimizer = make_optimizer(
-            lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
-            centered=lc.rmsprop_centered, max_grad_norm=lc.max_grad_norm,
-            lr_decay_steps=lc.lr_decay_steps, lr_decay_rate=lc.lr_decay_rate)
         self.key, init_key = jax.random.split(self.key)
-        carry0 = self.model.initial_state(1)
-        example_seq = jnp.zeros((1, t_total) + obs_shape,
-                                self.env.observation_space.dtype)
-        params = self.model.init(init_key, example_seq, carry0)
-        self.train_state = TrainState(
-            params=params, target_params=jax.tree.map(jnp.copy, params),
-            opt_state=optimizer.init(params), step=jnp.int32(0))
-        self.core = R2D2Core(model=self.model, replay=self.replay,
-                             optimizer=optimizer,
-                             batch_size=lc.batch_size,
-                             target_update_interval=lc.target_update_interval,
-                             burn_in=rc.burn_in, n_steps=lc.n_steps)
+        (self.model_spec, _obs_shape, _obs_dtype, self.model, self.replay,
+         self.replay_state, self.train_state, self.core) = build_r2d2(
+            cfg, init_key)
         self._train_step = self.core.jit_train_step()
-        self._ingest_max = self.core.jit_ingest_max()
+        self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_recurrent_policy_fn(self.model))
 
         self.builder = SequenceBuilder(rc.burn_in, rc.unroll, lc.n_steps,
                                        lc.gamma, stride=rc.stride)
         self._pending: list[dict] = []
-        self.ingest_group = 4
+        self.ingest_group = rc.sequence_group
         self.train_every = train_every
         self.epsilon = EpsilonSchedule()
         self.beta = BetaSchedule(start=cfg.replay.beta)
@@ -333,14 +424,15 @@ class R2D2Trainer(CheckpointableTrainer):
                 ch = np.asarray(carry[1][0])
             else:
                 cc = ch = None
-            actions, _q, carry = self._policy(
+            actions, q, carry = self._policy(
                 self.train_state.params, obs_np[None], carry,
                 jnp.float32(eps), act_key)
             action = int(actions[0])
 
             next_obs, reward, terminated, truncated, _ = self.env.step(action)
             self.builder.add_step(obs_np, action, float(reward),
-                                  bool(terminated), cc, ch)
+                                  bool(terminated), cc, ch,
+                                  q_values=np.asarray(q[0]))
             obs = next_obs
             episode_reward += float(reward)
             episode_len += 1
@@ -357,10 +449,12 @@ class R2D2Trainer(CheckpointableTrainer):
                 g = self.ingest_group
                 while len(self._pending) >= g:
                     take, self._pending = self._pending[:g], self._pending[g:]
+                    prios = jnp.asarray(
+                        np.stack([s.pop("priority") for s in take]))
                     batch = {k: jnp.asarray(np.stack([s[k] for s in take]))
                              for k in take[0]}
-                    self.replay_state = self._ingest_max(self.replay_state,
-                                                         batch)
+                    self.replay_state = self._ingest(self.replay_state,
+                                                     batch, prios)
                     self.sequences += g
                 obs, _ = self.env.reset()
                 carry = self.model.initial_state(1)
@@ -388,26 +482,93 @@ class R2D2Trainer(CheckpointableTrainer):
                         self.steps_rate.total)
         return self
 
-    # -- evaluation --------------------------------------------------------
+    # -- evaluation (shared with the concurrent trainer) -------------------
 
-    def evaluate(self, episodes: int = 10, epsilon: float = 0.0,
-                 max_steps: int = 10_000) -> float:
-        from apex_tpu.training.checkpoint import run_policy_episodes
+    evaluate = _r2d2_evaluate
 
-        if not hasattr(self, "_eval_env"):
-            self._eval_env = make_eval_env(self.cfg.env.env_id, self.cfg.env,
-                                           seed=self.cfg.env.seed + 999)
-        carry_box = [self.model.initial_state(1)]
 
-        def step_fn(obs, eps, k):
-            a, _, carry_box[0] = self._policy(self.train_state.params, obs,
-                                              carry_box[0], eps, k)
-            return int(a[0])
+class R2D2ApexTrainer(ConcurrentTrainer):
+    """Concurrent distributed R2D2 — the third family on the shared
+    Ape-X machinery: worker processes act statefully through
+    :class:`apex_tpu.actors.r2d2.R2D2WorkerFamily` (epsilon ladder,
+    conflating param queues, respawn) and ship grouped sequence messages;
+    the learner runs the fused sequence ingest+train step, optionally
+    scan-dispatched (``config.scan_steps``) or dp-sharded
+    (``config.learner.mesh_shape``).
 
-        self.key, eval_key = jax.random.split(self.key)
-        rewards = run_policy_episodes(
-            self._eval_env, step_fn, eval_key, episodes, epsilon, max_steps,
-            seed_base=self.cfg.env.seed + 1000,
-            reset_hook=lambda: carry_box.__setitem__(
-                0, self.model.initial_state(1)))
-        return float(np.mean(rewards))
+    Unit note: the replay-ratio knobs (``train_ratio``/
+    ``min_train_ratio``) compare learner SEQUENCES consumed (batch_size
+    counts sequences) against TRANSITIONS ingested — set them with the
+    sequence length in mind, or leave None (fully decoupled, the
+    reference behavior).
+    """
+
+    def __init__(self, config: ApexConfig | None = None,
+                 logdir: str | None = None, verbose: bool = False,
+                 publish_min_seconds: float = 0.2,
+                 train_ratio: float | None = None,
+                 min_train_ratio: float | None = None,
+                 checkpoint_dir: str | None = None,
+                 pool=None, respawn_workers: bool = True):
+        import dataclasses as _dc
+
+        from apex_tpu.actors.pool import ActorPool
+        from apex_tpu.actors.r2d2 import r2d2_worker_main
+
+        cfg = config or ApexConfig()
+        cfg = cfg.replace(env=_dc.replace(cfg.env, frame_stack=1))
+        self.cfg = cfg
+        self.key = set_global_seeds(cfg.env.seed)
+        self.publish_min_seconds = publish_min_seconds
+        self.train_ratio = train_ratio
+        self.min_train_ratio = min_train_ratio
+        self.respawn_workers = respawn_workers
+        if (train_ratio is not None and min_train_ratio is not None
+                and min_train_ratio > train_ratio):
+            raise ValueError("min_train_ratio must be <= train_ratio")
+
+        rc, lc = cfg.r2d2, cfg.learner
+        self.key, init_key = jax.random.split(self.key)
+        (self.model_spec, obs_shape, obs_dtype, self.model, self.replay,
+         self.replay_state, self.train_state, self.core) = build_r2d2(
+            cfg, init_key)
+        self._policy = jax.jit(make_recurrent_policy_fn(self.model))
+
+        if pool is not None:
+            self.pool = pool
+        else:
+            if cfg.actor.n_envs_per_actor > 1:
+                raise ValueError(
+                    "vectorized R2D2 actors are not implemented yet: "
+                    "set n_envs_per_actor=1 (batched recurrent carries "
+                    "are a planned extension)")
+            group = rc.sequence_group
+            t_total = rc.burn_in + rc.unroll + lc.n_steps
+            obs_bytes = int(np.prod(obs_shape)) * np.dtype(obs_dtype).itemsize
+            slot = group * t_total * (obs_bytes + 16) \
+                + group * 8 * rc.lstm_features + 65536
+            self.pool = ActorPool(cfg, self.model_spec,
+                                  chunk_transitions=group,
+                                  worker_fn=r2d2_worker_main,
+                                  shm_slot_bytes=slot)
+
+        self.n_dp = int(np.prod(lc.mesh_shape))
+        if self.n_dp > 1:
+            self._init_sharded()
+        else:
+            self._fused = self.core.jit_fused_step()
+            self._train = self.core.jit_train_step()
+            self._ingest = self.core.jit_ingest()
+            if lc.scan_steps > 1:
+                self.scan_steps = lc.scan_steps
+                self._multi = self.core.jit_fused_multi_step()
+
+        self.log = MetricLogger("learner", logdir, verbose=verbose)
+        self.steps_rate = RateCounter()
+        self.frames_rate = RateCounter()
+        self.ingested = 0
+        self.param_version = 0
+        self.checkpointer = (Checkpointer(checkpoint_dir)
+                             if checkpoint_dir else None)
+
+    evaluate = _r2d2_evaluate

@@ -308,6 +308,60 @@ def _aql_actor_main(cfg, actor_id, n_actors):
               barrier_timeout_s=60)
 
 
+def _r2d2_actor_main(cfg, actor_id, n_actors):
+    from apex_tpu.runtime.roles import run_actor
+    run_actor(cfg, RoleIdentity(role="actor", actor_id=actor_id,
+                                n_actors=n_actors), family="r2d2",
+              barrier_timeout_s=60)
+
+
+@pytest.mark.slow
+def test_localhost_r2d2_topology():
+    """The recurrent family over real TCP (C13/C14 for the third model
+    family): stateful actor processes ship grouped sequence messages to
+    the socket learner, which trains the fused sequence step and
+    publishes back."""
+    n_actors = 2
+    cfg = _test_config(n_actors)
+    cfg = cfg.replace(
+        env=dataclasses.replace(cfg.env, env_id="ApexCartPolePO-v0"))
+    ctx = mp.get_context("spawn")
+
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    procs = []
+    try:
+        for i in range(n_actors):
+            procs.append(ctx.Process(target=_r2d2_actor_main,
+                                     args=(cfg, i, n_actors), daemon=True))
+        for p in procs:
+            p.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    from apex_tpu.runtime.roles import run_learner
+    try:
+        trainer = run_learner(cfg, n_peers=n_actors, total_steps=25,
+                              max_seconds=180, family="r2d2",
+                              barrier_timeout_s=60)
+        assert trainer.steps_rate.total >= 25
+        assert trainer.ingested >= cfg.replay.warmup
+        assert trainer.param_version >= 2
+        assert trainer.log.history.get("learner/episode_reward")
+        assert np.isfinite(trainer.evaluate(episodes=1, max_steps=60))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=10)
+
+
 @pytest.mark.slow
 def test_localhost_aql_topology():
     """The AQL family over real TCP (C13/C14 for the second model family):
