@@ -24,12 +24,27 @@ from apex_tpu.config import ApexConfig
 
 def sequence_message(seqs: list[dict]) -> dict:
     """Stack ``group`` drained sequences into one fixed-shape pool message.
-    ``n_trans`` counts REAL steps (mask sum) so the learner's
-    transition-denominated warmup/ratio gates stay meaningful."""
+    ``n_trans`` sums the sequences' ``n_new`` (env steps NEW to each
+    sequence vs its overlapping predecessors — every real step counts
+    exactly once per episode), keeping the learner's
+    transition-denominated warmup/ratio gates honest despite the stride
+    overlap."""
     prios = np.stack([s.pop("priority") for s in seqs])
+    n_new = sum(s.pop("n_new") for s in seqs)
     payload = {k: np.stack([s[k] for s in seqs]) for k in seqs[0]}
-    return {"payload": payload, "priorities": prios,
-            "n_trans": int(sum(int(s["mask"].sum()) for s in seqs))}
+    return {"payload": payload, "priorities": prios, "n_trans": int(n_new)}
+
+
+def drain_grouped(ready: list[dict], group: int) -> list[dict]:
+    """THE one group-batching drain: pop full groups of ``group``
+    sequences off ``ready`` (in place) as fixed-shape messages; partial
+    groups stay buffered for the next drain.  Shared by the scalar and
+    vector worker families and the single-process driver."""
+    out = []
+    while len(ready) >= group:
+        take, ready[:] = ready[:group], ready[group:]
+        out.append(sequence_message(take))
+    return out
 
 
 class R2D2WorkerFamily:
@@ -81,12 +96,7 @@ class R2D2WorkerFamily:
         return next_obs, float(reward), bool(term), bool(trunc)
 
     def poll_msgs(self) -> list[dict]:
-        out = []
-        while len(self._ready) >= self.group:
-            take = self._ready[:self.group]
-            self._ready = self._ready[self.group:]
-            out.append(sequence_message(take))
-        return out
+        return drain_grouped(self._ready, self.group)
 
 
 def r2d2_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
@@ -101,3 +111,117 @@ def r2d2_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
                               group=chunk_transitions)
     worker_loop(actor_id, cfg, family, chunk_queue, param_queue, stat_queue,
                 stop_event, epsilon)
+
+
+class VectorR2D2WorkerFamily:
+    """B-env recurrent acting: ONE batched policy call advances B carries
+    ``[B, H]`` in lockstep; per-slot SequenceBuilders cut overlapping
+    windows, and a slot's carry row zeroes on its episode reset.  Built on
+    :class:`apex_tpu.actors.vector.VectorFamilyBase` for the slot ladder /
+    accounting / auto-reset machinery every vector family shares."""
+
+    def __init__(self, cfg: ApexConfig, model_spec: dict, seeds, slot_ids,
+                 epsilons, group: int):
+        import jax
+
+        from apex_tpu.actors.vector import VectorFamilyBase
+        from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
+                                               make_recurrent_policy_fn)
+        from apex_tpu.training.r2d2 import SequenceBuilder
+
+        # composition over inheritance for the base: __init__ calls
+        # _make_env before our model exists, so wire hooks explicitly
+        class _Base(VectorFamilyBase):
+            def _make_env(base, seed):
+                from apex_tpu.envs.registry import make_env
+                return make_env(cfg.env.env_id, cfg.env, seed=seed,
+                                max_episode_steps=(
+                                    cfg.actor.max_episode_length))
+
+            def _on_reset(base, i, obs):
+                self._obs[i] = np.asarray(obs)
+                c, h = self.carry
+                self.carry = (c.at[i].set(0.0), h.at[i].set(0.0))
+
+        self._obs: list = [None] * len(list(seeds))
+        self.base = _Base(cfg, seeds, slot_ids, epsilons)
+        self.model = RecurrentDuelingDQN(**model_spec)
+        self.policy = jax.jit(make_recurrent_policy_fn(self.model))
+        self.carry = self.model.initial_state(self.base.n_envs)
+        rc = cfg.r2d2
+        self.builders = [
+            SequenceBuilder(rc.burn_in, rc.unroll, cfg.learner.n_steps,
+                            cfg.learner.gamma, stride=rc.stride)
+            for _ in range(self.base.n_envs)]
+        self.group = group
+        self._ready: list[dict] = []
+
+    # base delegation (vector_worker_loop drives these)
+    @property
+    def seeds(self):
+        return self.base.seeds
+
+    @property
+    def n_envs(self):
+        return self.base.n_envs
+
+    def reset_all(self) -> None:
+        self.base.reset_all()
+
+    def close(self) -> None:
+        self.base.close()
+
+    def step_all(self, params, key) -> list:
+        import jax.numpy as jnp
+
+        obs = np.stack(self._obs)
+        need = [b.needs_carry for b in self.builders]
+        if any(need):           # ONE batched device->host carry transfer
+            cc_all = np.asarray(self.carry[0])
+            ch_all = np.asarray(self.carry[1])
+        actions, q, self.carry = self.policy(
+            params, obs, self.carry,
+            jnp.asarray(self.base._current_eps()), key)
+        actions, q = np.asarray(actions), np.asarray(q)
+
+        stats: list = []
+        for i, env in enumerate(self.base.envs):
+            a = int(actions[i])
+            next_obs, reward, term, trunc, _ = env.step(a)
+            self.builders[i].add_step(
+                obs[i], a, float(reward), bool(term),
+                cc_all[i] if need[i] else None,
+                ch_all[i] if need[i] else None,
+                q_values=q[i])
+            if term or trunc:
+                self.builders[i].end_episode(
+                    truncated=bool(trunc and not term))
+                self._ready.extend(self.builders[i].drain())
+            else:
+                self._obs[i] = np.asarray(next_obs)
+            # on done: auto-reset calls _on_reset (obs + carry-row zero)
+            self.base._finish_step(i, float(reward), bool(term or trunc),
+                                   stats)
+        return stats
+
+    def poll_msgs(self) -> list[dict]:
+        return drain_grouped(self._ready, self.group)
+
+
+def vector_r2d2_worker_main(actor_id: int, cfg: ApexConfig,
+                            model_spec: dict, chunk_queue, param_queue,
+                            stat_queue, stop_event, epsilon: float,
+                            chunk_transitions: int) -> None:
+    """B-env recurrent worker body (``epsilon`` ignored: slots re-derive
+    theirs from the global ladder, like every vector family)."""
+    from apex_tpu.actors.vector import vector_worker_loop, worker_slots
+
+    slot_ids, seeds, epsilons = worker_slots(cfg, actor_id)
+    family = VectorR2D2WorkerFamily(cfg, model_spec, seeds=seeds,
+                                    slot_ids=slot_ids, epsilons=epsilons,
+                                    group=chunk_transitions)
+    vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
+                       stat_queue, stop_event)
+
+
+vector_r2d2_worker_main.is_vector = True     # ActorPool guard marker

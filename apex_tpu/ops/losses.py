@@ -51,8 +51,16 @@ def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
     return jnp.where(absx < delta, 0.5 * x * x, delta * (absx - 0.5 * delta))
 
 
+# max/mean (or max/per-item) priority mix weight — ONE constant shared by
+# the batch-level heuristic below, the sequence loss (r2d2_loss), and the
+# acting-time sequence priorities (training/r2d2.py:SequenceBuilder) so
+# learner write-back and actor inserts can't drift onto different mixes
+PRIORITY_ETA = 0.9
+
+
 def mixed_max_priorities(td_abs: jax.Array, eps: float = 1e-6) -> jax.Array:
-    return 0.9 * td_abs.max() + 0.1 * td_abs + eps
+    return (PRIORITY_ETA * td_abs.max()
+            + (1.0 - PRIORITY_ETA) * td_abs + eps)
 
 
 def double_dqn_loss(
@@ -103,7 +111,7 @@ def r2d2_loss(
     *,
     burn_in: int,
     n_steps: int,
-    eta: float = 0.9,
+    eta: float = PRIORITY_ETA,
     eps: float = 1e-6,
 ) -> tuple[jax.Array, TDOutput]:
     """Sequence double-DQN loss for the recurrent family (R2D2 recipe on

@@ -193,14 +193,17 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     elif family == "r2d2":
         from apex_tpu.actors.r2d2 import r2d2_worker_main
         from apex_tpu.training.r2d2 import r2d2_model_spec
-        if cfg.actor.n_envs_per_actor > 1:
-            raise ValueError("vectorized R2D2 actors are not implemented")
         model_spec = r2d2_model_spec(cfg)
         # single frames (the LSTM is the memory); the sequence group per
         # message is the one shared cfg.r2d2 constant, so actor messages
         # and the learner's expected shapes can't drift
         cfg = cfg.replace(env=dataclasses.replace(cfg.env, frame_stack=1))
         worker_fn, chunk_arg = r2d2_worker_main, cfg.r2d2.sequence_group
+        if cfg.actor.n_envs_per_actor > 1:
+            from apex_tpu.actors.r2d2 import vector_r2d2_worker_main
+            worker_fn = vector_r2d2_worker_main
+            cfg = cfg.replace(actor=dataclasses.replace(
+                cfg.actor, n_actors=identity.n_actors))
     else:
         raise ValueError(f"unknown family {family!r}")
     try:
@@ -257,6 +260,7 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
 
     from apex_tpu.actors.pool import EpisodeStat
 
+    reset_act = None            # recurrent families override per episode
     if family == "dqn":
         from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
         from apex_tpu.training.apex import dqn_model_spec
@@ -296,8 +300,6 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             carry_box[0] = model.initial_state(1)
     else:
         raise ValueError(f"unknown family {family!r}")
-    if family != "r2d2":
-        reset_act = None
 
     got = sub.wait_first(stop_event)
     if got is None:

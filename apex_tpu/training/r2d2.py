@@ -33,11 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from apex_tpu.actors.r2d2 import drain_grouped
 from apex_tpu.config import ApexConfig
 from apex_tpu.envs.registry import make_env, make_eval_env, num_actions
 from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
                                        make_recurrent_policy_fn)
-from apex_tpu.ops.losses import make_optimizer, r2d2_loss
+from apex_tpu.ops.losses import PRIORITY_ETA, make_optimizer, r2d2_loss
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.device import DeviceReplay
 from apex_tpu.training.apex import ConcurrentTrainer
@@ -127,6 +128,8 @@ class SequenceBuilder:
             mask_full[max(0, n - self.n_steps):] = 0.0
         td_full = self._acting_time_tds(n)
         obs = np.stack(self._obs)
+        emitted: list[dict] = []
+        starts: list[int] = []
         start = 0
         while start + self.burn_in < n:
             end = min(start + self.t_total, n)
@@ -153,11 +156,21 @@ class SequenceBuilder:
                     self.burn_in:self.burn_in + self.unroll] * lm
                 nv = max(lm.sum(), 1.0)
                 seq["priority"] = np.float32(
-                    0.9 * td.max() + 0.1 * td.sum() / nv + 1e-6)
+                    PRIORITY_ETA * td.max()
+                    + (1.0 - PRIORITY_ETA) * td.sum() / nv + 1e-6)
             else:
                 seq["priority"] = np.float32(1.0)
-            self._out.append(seq)
+            emitted.append(seq)
+            starts.append(start)
             start += self.stride
+        # n_new: NEW env transitions this sequence contributes vs its
+        # overlapping predecessors — step t counts exactly once across the
+        # episode, so transition-denominated gates (warmup, replay ratio)
+        # stay honest despite the stride overlap
+        for i, (seq, s) in enumerate(zip(emitted, starts)):
+            nxt = starts[i + 1] if i + 1 < len(starts) else n
+            seq["n_new"] = int(min(nxt, n) - s)
+        self._out.extend(emitted)
         self._obs, self._action, self._reward = [], [], []
         self._discount, self._carry, self._q = [], [], []
 
@@ -446,16 +459,11 @@ class R2D2Trainer(CheckpointableTrainer):
                 # no per-count retrace; remainders wait for the next
                 # episode's drain
                 self._pending.extend(self.builder.drain())
-                g = self.ingest_group
-                while len(self._pending) >= g:
-                    take, self._pending = self._pending[:g], self._pending[g:]
-                    prios = jnp.asarray(
-                        np.stack([s.pop("priority") for s in take]))
-                    batch = {k: jnp.asarray(np.stack([s[k] for s in take]))
-                             for k in take[0]}
-                    self.replay_state = self._ingest(self.replay_state,
-                                                     batch, prios)
-                    self.sequences += g
+                for msg in drain_grouped(self._pending, self.ingest_group):
+                    self.replay_state = self._ingest(
+                        self.replay_state, msg["payload"],
+                        jnp.asarray(msg["priorities"]))
+                    self.sequences += self.ingest_group
                 obs, _ = self.env.reset()
                 carry = self.model.initial_state(1)
                 self.log.scalars({"episode_reward": episode_reward,
@@ -537,11 +545,10 @@ class R2D2ApexTrainer(ConcurrentTrainer):
         if pool is not None:
             self.pool = pool
         else:
+            worker = r2d2_worker_main
             if cfg.actor.n_envs_per_actor > 1:
-                raise ValueError(
-                    "vectorized R2D2 actors are not implemented yet: "
-                    "set n_envs_per_actor=1 (batched recurrent carries "
-                    "are a planned extension)")
+                from apex_tpu.actors.r2d2 import vector_r2d2_worker_main
+                worker = vector_r2d2_worker_main
             group = rc.sequence_group
             t_total = rc.burn_in + rc.unroll + lc.n_steps
             obs_bytes = int(np.prod(obs_shape)) * np.dtype(obs_dtype).itemsize
@@ -549,7 +556,7 @@ class R2D2ApexTrainer(ConcurrentTrainer):
                 + group * 8 * rc.lstm_features + 65536
             self.pool = ActorPool(cfg, self.model_spec,
                                   chunk_transitions=group,
-                                  worker_fn=r2d2_worker_main,
+                                  worker_fn=worker,
                                   shm_slot_bytes=slot)
 
         self.n_dp = int(np.prod(lc.mesh_shape))

@@ -221,6 +221,9 @@ def test_sequence_builder_segmentation_and_padding():
                 assert d[t] == 0.0
             else:
                 assert d[t] == pytest.approx(0.9)
+    # every env step is attributed to exactly ONE sequence despite the
+    # stride overlap: transition-denominated gates stay honest
+    assert sum(s["n_new"] for s in seqs) == ep_len
     assert b.drain() == []
 
 
@@ -335,6 +338,26 @@ def test_r2d2_apex_pipeline_mechanics():
     assert t.log.history.get("learner/episode_reward")
     assert all(not p.is_alive() for p in t.pool.procs)
     assert np.isfinite(t.evaluate(episodes=1, max_steps=100))
+
+
+@pytest.mark.slow
+def test_r2d2_apex_vector_actors():
+    """Vectorized recurrent actors: 1 process x 4 env slots act through
+    ONE batched policy call advancing a [B, H] carry; a slot's carry row
+    zeroes on its episode reset; slots carry global ladder ids."""
+    from apex_tpu.training.r2d2 import R2D2ApexTrainer
+
+    cfg = small_test_config(capacity=1024, batch_size=16, n_actors=1,
+                            env_id="ApexCartPolePO-v0")
+    cfg = cfg.replace(actor=dataclasses.replace(cfg.actor,
+                                                n_envs_per_actor=4))
+    t = R2D2ApexTrainer(cfg, publish_min_seconds=0.05)
+    t.train(total_steps=25, max_seconds=240)
+    assert t.steps_rate.total >= 25
+    assert t.ingested >= cfg.replay.warmup
+    slots = {int(v) for _, v in t.log.history.get("learner/actor_id", [])}
+    assert slots and max(slots) > 0, f"vector slots missing: {slots}"
+    assert all(not p.is_alive() for p in t.pool.procs)
 
 
 def test_sequence_builder_acting_time_priorities():
