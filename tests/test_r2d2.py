@@ -341,6 +341,22 @@ def test_r2d2_apex_pipeline_mechanics():
 
 
 @pytest.mark.slow
+def test_r2d2_pixel_pipeline_mechanics():
+    """The recurrent family on PIXELS: single 42x42 uint8 frames (no
+    stack — the LSTM is the memory), conv trunk per step around the
+    lax.scan unroll, sequence replay holding image sequences.  A few
+    training steps prove the shape plumbing end to end."""
+    cfg = small_test_config(capacity=256, batch_size=8,
+                            env_id="ApexCatchSmall-v0")
+    t = R2D2Trainer(cfg)
+    assert t.env.observation_space.shape == (42, 42, 1)   # single frame
+    t.train(total_frames=700, log_every=10 ** 9, warmup_sequences=8)
+    assert t.steps_rate.total > 0
+    assert t.sequences >= 8
+    assert np.isfinite(t.evaluate(episodes=1, max_steps=30))
+
+
+@pytest.mark.slow
 def test_r2d2_apex_vector_actors():
     """Vectorized recurrent actors: 1 process x 4 env slots act through
     ONE batched policy call advancing a [B, H] carry; a slot's carry row
