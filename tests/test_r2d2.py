@@ -341,6 +341,25 @@ def test_r2d2_apex_pipeline_mechanics():
 
 
 @pytest.mark.slow
+def test_r2d2_apex_scan_dispatch_mechanics():
+    """config.scan_steps wires the R2D2 core's fused_multi_step into the
+    concurrent loop like the other families (sequence ingest + unrolled
+    update inside lax.scan)."""
+    from apex_tpu.training.r2d2 import R2D2ApexTrainer
+
+    cfg = small_test_config(capacity=1024, batch_size=16, n_actors=2,
+                            env_id="ApexCartPolePO-v0")
+    cfg = cfg.replace(learner=dataclasses.replace(cfg.learner,
+                                                  scan_steps=2))
+    t = R2D2ApexTrainer(cfg, publish_min_seconds=0.05)
+    assert t._multi is not None
+    t.train(total_steps=25, max_seconds=240)
+    assert t.steps_rate.total >= 25
+    assert t.scan_dispatches > 0, "scan path never fired"
+    assert all(not p.is_alive() for p in t.pool.procs)
+
+
+@pytest.mark.slow
 def test_r2d2_pixel_pipeline_mechanics():
     """The recurrent family on PIXELS: single 42x42 uint8 frames (no
     stack — the LSTM is the memory), conv trunk per step around the
