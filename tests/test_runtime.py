@@ -318,13 +318,14 @@ def _r2d2_actor_main(cfg, actor_id, n_actors):
 @pytest.mark.slow
 def test_localhost_r2d2_topology():
     """The recurrent family over real TCP (C13/C14 for the third model
-    family): stateful actor processes ship grouped sequence messages to
-    the socket learner, which trains the fused sequence step and
-    publishes back."""
+    family): VECTORIZED stateful actor processes (2 env slots each, one
+    batched [B, H] carry) ship grouped sequence messages to the socket
+    learner, which trains the fused sequence step and publishes back."""
     n_actors = 2
     cfg = _test_config(n_actors)
     cfg = cfg.replace(
-        env=dataclasses.replace(cfg.env, env_id="ApexCartPolePO-v0"))
+        env=dataclasses.replace(cfg.env, env_id="ApexCartPolePO-v0"),
+        actor=dataclasses.replace(cfg.actor, n_envs_per_actor=2))
     ctx = mp.get_context("spawn")
 
     saved = {k: os.environ.get(k)
