@@ -112,6 +112,24 @@ def resolved_mode(frames: jax.Array, mode: str = "auto") -> str:
     and ``bench.py`` attempts that opt-in LAST, after the safe numbers
     are recorded."""
     if mode != "auto":
+        if mode == "pallas":
+            # explicit API opt-in gets the same per-operand eligibility
+            # gate as the env override — but loudly: the caller named the
+            # kernel path, so an unsliceable layout is a usage error
+            # worth a clear message, not a Mosaic lowering traceback (and
+            # not a silent xla swap that would misreport what's being
+            # measured).  ``interpret`` stays permissive down to the
+            # d % 8 row-view check in :func:`gather_rows` — it is the CPU
+            # emulation lane and deliberately parity-tests layouts the
+            # chip would reject.
+            d = math.prod(frames.shape[1:])
+            if not (frames.ndim == 3 and pallas_eligible(d, frames.dtype)):
+                raise ValueError(
+                    f"gather_mode='pallas' needs the tiled 3-D ring view "
+                    f"[F, 8, D/8] with rows of whole (8, 128) tiles "
+                    f"(D % {ROW_UNIT} == 0) and a 1- or 4-byte dtype; "
+                    f"got shape {frames.shape} dtype {frames.dtype}. "
+                    f"Use 'xla' (or 'auto') for this layout.")
         return mode
     forced = os.environ.get("APEX_GATHER_MODE")
     if forced not in (None, "", "auto"):

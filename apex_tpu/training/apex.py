@@ -210,15 +210,25 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     msgs = pool.poll_chunks(want, timeout=0 if warm else 0.05)
 
                 if want > 1 and len(msgs) == want:
-                    # full scan batch: K chunks -> one device dispatch
+                    # full scan batch: K chunks -> one device dispatch.
+                    # Betas are the per-step stack the single-dispatch
+                    # path would have produced (step i sees ingestion
+                    # through chunk i-1), so the annealing schedule is
+                    # dispatch-shape-invariant.
                     payload, prios, n_new = stack_chunk_messages(msgs)
+                    n_per = np.asarray([int(m["n_trans"]) for m in msgs])
+                    offsets = np.concatenate([[0], np.cumsum(n_per)[:-1]])
+                    betas = np.asarray(
+                        [self._beta(self.ingested + int(o))
+                         for o in offsets], np.float32)
                     self.key, k = jax.random.split(self.key)
                     self.train_state, self.replay_state, mm = \
                         self._multi(self.train_state, self.replay_state,
                                     payload, prios,
-                                    jax.random.split(k, want),
-                                    jnp.float32(self._beta()))
-                    metrics = jax.tree.map(lambda x: x[-1], mm)
+                                    jax.random.split(k, want), betas)
+                    # scalar observability coarsens to per-dispatch under
+                    # scan: report the mean over the K stacked steps
+                    metrics = jax.tree.map(lambda x: x.mean(0), mm)
                     self.steps_rate.tick(want)
                     self.scan_dispatches += 1
                     self.ingested += n_new
@@ -326,8 +336,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 stop.clear()
         return self
 
-    def _beta(self) -> float:
-        frac = min(1.0, self.ingested / max(1, self.cfg.replay.beta_anneal))
+    def _beta(self, ingested: int | None = None) -> float:
+        n = self.ingested if ingested is None else ingested
+        frac = min(1.0, n / max(1, self.cfg.replay.beta_anneal))
         return self.cfg.replay.beta + (1.0 - self.cfg.replay.beta) * frac
 
     # -- checkpointing (A4): format/IO in CheckpointableTrainer ------------

@@ -176,17 +176,25 @@ def scan_fused_steps(core, train_state, replay_state, ingest_batches,
     (the reference pays it as queue.get + H2D per batch,
     ``origin_repo/learner.py:152-170``; this framework pays it as an RPC
     on relay-backed chips).  Metrics come back stacked ``[K]``.
+
+    ``beta`` may be a scalar (one annealing value for all K steps) or a
+    ``[K]`` stack — the concurrent trainer passes the per-step stack the
+    single-dispatch path would have computed as ingestion advanced, so
+    the two dispatch shapes anneal identically.
     """
+    k_steps = keys.shape[0]
+    betas = jnp.broadcast_to(jnp.asarray(beta, jnp.float32), (k_steps,))
+
     def body(carry, xs):
         ts, rs = carry
-        chunk, prios, key = xs
+        chunk, prios, key, b = xs
         rs = core.ingest(rs, chunk, prios)
-        ts, rs, metrics = core.train_step(ts, rs, key, beta)
+        ts, rs, metrics = core.train_step(ts, rs, key, b)
         return (ts, rs), metrics
 
     (train_state, replay_state), metrics = jax.lax.scan(
         body, (train_state, replay_state),
-        (ingest_batches, ingest_prios, keys))
+        (ingest_batches, ingest_prios, keys, betas))
     return train_state, replay_state, metrics
 
 

@@ -387,6 +387,7 @@ class R2D2Trainer(CheckpointableTrainer):
         self.builder = SequenceBuilder(rc.burn_in, rc.unroll, lc.n_steps,
                                        lc.gamma, stride=rc.stride)
         self._pending: list[dict] = []
+        self.transitions = 0
         self.ingest_group = rc.sequence_group
         self.train_every = train_every
         self.epsilon = EpsilonSchedule()
@@ -402,24 +403,32 @@ class R2D2Trainer(CheckpointableTrainer):
 
     def _counters(self) -> dict:
         return dict(sequences=self.sequences, frames=self.frames_rate.total,
-                    steps=self.steps_rate.total)
+                    steps=self.steps_rate.total, transitions=self.transitions)
 
     def _apply_counters(self, meta: dict) -> None:
         self.sequences = meta["sequences"]
         self.frames_rate.total = meta["frames"]
         self.steps_rate.total = meta["steps"]
+        # absent in pre-round-5 checkpoints: fall back to the old
+        # sequence-derived estimate so resumes stay monotonic
+        self.transitions = meta.get(
+            "transitions", meta["sequences"] * self.builder.t_total)
 
     # -- main loop ---------------------------------------------------------
 
     def train(self, total_frames: int, log_every: int = 1000,
               warmup_sequences: int | None = None):
         cfg = self.cfg
-        # the configured warmup gate (cfg.replay.warmup, in TRANSITIONS —
-        # same knob every other trainer honors) converted to sequences,
-        # floored at one full batch so early sampling isn't all-duplicates
-        warmup = (warmup_sequences if warmup_sequences is not None
-                  else max(cfg.learner.batch_size,
-                           cfg.replay.warmup // self.builder.t_total))
+        # warmup gates on UNIQUE env transitions accumulated (sum of each
+        # sequence's n_new), not sequence count: with stride < t_total the
+        # windows overlap, so seq_count * t_total overstates coverage
+        # ~t_total/stride-fold.  Matches the concurrent trainer's
+        # ``ingested >= warmup`` semantics.  A sequence floor of one full
+        # batch keeps early sampling from being all-duplicates.
+        warmup_seqs = (warmup_sequences if warmup_sequences is not None
+                       else cfg.learner.batch_size)
+        warmup_trans = 0 if warmup_sequences is not None \
+            else cfg.replay.warmup
         obs, _ = self.env.reset(seed=cfg.env.seed)
         carry = self.model.initial_state(1)
         episode_reward, episode_len, episode_idx = 0.0, 0, 0
@@ -464,6 +473,7 @@ class R2D2Trainer(CheckpointableTrainer):
                         self.replay_state, msg["payload"],
                         jnp.asarray(msg["priorities"]))
                     self.sequences += self.ingest_group
+                    self.transitions += int(msg["n_trans"])
                 obs, _ = self.env.reset()
                 carry = self.model.initial_state(1)
                 self.log.scalars({"episode_reward": episode_reward,
@@ -471,7 +481,8 @@ class R2D2Trainer(CheckpointableTrainer):
                 episode_reward, episode_len = 0.0, 0
                 episode_idx += 1
 
-            if (self.sequences >= warmup
+            if (self.sequences >= warmup_seqs
+                    and self.transitions >= warmup_trans
                     and frame % self.train_every == 0):
                 self.key, step_key = jax.random.split(self.key)
                 self.train_state, self.replay_state, metrics = \
