@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import threading
@@ -76,14 +77,21 @@ MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 50))
 REPS = int(os.environ.get("BENCH_REPS", 3))
 # first TPU compile of the concurrent pipeline eats ~20-40s of this wall
 # budget and the 2048-transition warmup a further slice; the steady-state
-# window after both is what the sliding rate counters report
-E2E_SECONDS = float(os.environ.get("BENCH_E2E_SECONDS", 120.0))
+# window after both is what the sliding rate counters report.  On TPU the
+# e2e stage is a SOAK: >=300s wall so that >=180s of post-compile steady
+# state is measured (round numbers must not be a 37-step sliver); the CPU
+# diagnostic lane keeps the short default.
+def _e2e_seconds(platform: str) -> float:
+    if "BENCH_E2E_SECONDS" in os.environ:
+        return float(os.environ["BENCH_E2E_SECONDS"])
+    return 300.0 if platform == "tpu" else 120.0
+
+
 # stage deadlines (watchdog): generous but finite — the whole bench must
 # land inside the driver's outer timeout with the JSON line printed
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 240.0))
 PART1_TIMEOUT = float(os.environ.get("BENCH_PART1_TIMEOUT", 360.0))
-PART2_TIMEOUT = E2E_SECONDS + float(
-    os.environ.get("BENCH_PART2_MARGIN", 240.0))
+PART2_MARGIN = float(os.environ.get("BENCH_PART2_MARGIN", 240.0))
 
 # -- watchdog ---------------------------------------------------------------
 
@@ -337,10 +345,11 @@ def bench_fused_step() -> dict:
 
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
-def bench_end_to_end() -> dict:
+def bench_end_to_end(e2e_seconds: float) -> dict:
     """The real ApexTrainer pipeline — vectorized actor processes feeding
     the fused learner through the shm chunk plane — on the PIXEL env
-    ``ApexCatch-v0`` (84x84x4 uint8, flagship geometry) for E2E_SECONDS."""
+    ``ApexCatch-v0`` (84x84x4 uint8, flagship geometry) for
+    ``e2e_seconds`` (a >=300s soak on TPU, see :func:`_e2e_seconds`)."""
     from apex_tpu.config import (ActorConfig, ApexConfig, EnvConfig,
                                  LearnerConfig, ReplayConfig)
     from apex_tpu.training.apex import ApexTrainer
@@ -373,13 +382,56 @@ def bench_end_to_end() -> dict:
     stacked = shape[:-1] + (trainer.replay.frame_stack * shape[-1],)
     geometry = ("x".join(map(str, stacked))
                 + "_" + trainer.replay.frame_dtype)
+    # sample the monotone totals every 15s from a sidecar thread: the
+    # consecutive-sample deltas give per-window steps/s, whose spread is
+    # the soak's stability evidence (a sliding-window rate alone can't
+    # show whether the run was steady or saw-toothed)
+    samples: list[tuple[float, int, int]] = []
+    sampler_stop = threading.Event()
+
+    def _sampler() -> None:
+        while not sampler_stop.wait(15.0):
+            samples.append((time.monotonic(), trainer.steps_rate.total,
+                            trainer.frames_rate.total))
+
+    sampler = threading.Thread(target=_sampler, daemon=True)
+    sampler.start()
     t0 = time.monotonic()
-    trainer.train(total_steps=10 ** 9, max_seconds=E2E_SECONDS,
-                  log_every=10 ** 9)
+    try:
+        trainer.train(total_steps=10 ** 9, max_seconds=e2e_seconds,
+                      log_every=10 ** 9)
+    finally:
+        # always unpin: a still-sampling daemon would otherwise keep the
+        # trainer (and its HBM replay ring) alive through the pallas stage
+        sampler_stop.set()
     dt = time.monotonic() - t0
+
+    # steady state = windows after the first one in which the learner
+    # stepped (compile + replay warmup fill the preceding ones)
+    windows = []
+    steady_start = None
+    for (ta, sa, _fa), (tb, sb, _fb) in zip(samples, samples[1:]):
+        if sa > 0:
+            if steady_start is None:
+                steady_start = (ta, sa)
+            windows.append((sb - sa) / (tb - ta))
+    steady = None
+    if steady_start is not None and samples and samples[-1][1] > steady_start[1]:
+        t_first, s_first = steady_start
+        t_last, s_last, _ = samples[-1]
+        steady = {
+            "steps_per_sec": round((s_last - s_first) / (t_last - t_first), 2),
+            "seconds": round(t_last - t_first, 1),
+            "windows": {"n": len(windows),
+                        "min": round(min(windows), 2),
+                        "p50": round(float(statistics.median(windows)), 2),
+                        "max": round(max(windows), 2)} if windows else None,
+        }
+
     # steady-state rates from the sliding tick windows — first-compile time
     # (~20-40s of the wall budget) would otherwise dominate the average
     return {"env": env_id,
+            "steady": steady,
             "obs_geometry": geometry,
             "env_frames_per_sec": round(trainer.frames_rate.rate, 1),
             "learner_steps_per_sec": round(trainer.steps_rate.rate, 2),
@@ -440,9 +492,10 @@ def main() -> None:
     print(f"[bench] part 1 done: {json.dumps(RESULT)}",
           file=sys.stderr, flush=True)
 
-    _arm("e2e", PART2_TIMEOUT)
+    e2e_seconds = _e2e_seconds(platform)
+    _arm("e2e", e2e_seconds + PART2_MARGIN)
     try:
-        e2e = bench_end_to_end()
+        e2e = bench_end_to_end(e2e_seconds)
     except Exception as exc:      # never lose the primary metric
         e2e = {"error": f"{type(exc).__name__}: {exc}"}
     with _print_lock:
