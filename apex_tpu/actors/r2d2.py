@@ -35,15 +35,81 @@ def sequence_message(seqs: list[dict]) -> dict:
     return {"payload": payload, "priorities": prios, "n_trans": int(n_new)}
 
 
-def drain_grouped(ready: list[dict], group: int) -> list[dict]:
+def pooled_sequence_message(seqs: list[dict]) -> dict:
+    """Pack ``group`` drained POOLED sequences (SequenceBuilder with
+    ``pooled=True``) into one fixed-shape message for
+    :meth:`apex_tpu.replay.seq_pool.SequenceFramePoolReplay.add`.
+
+    Frame economy: each referenced frame ships ONCE.  Windows over the
+    same episode share that episode's frame array (``ep_frames``), so the
+    packer ships the union coverage ``[min start, max end)`` per episode
+    — the overlap between consecutive windows (R2D2's stride < t_total)
+    costs nothing within a message; only window overlap ACROSS message
+    boundaries is reshipped (t_total - stride rows per boundary,
+    amortized by the group size).
+
+    Fixed shapes, variable fill: ``frames`` is ``[G*T + 1, D]`` with
+    ``n_frames`` real rows — row 0 is the all-zero frame every padded
+    sequence position references, and rows past ``n_frames`` stay zero
+    (the pool redirects them onto row 0's slot: identical duplicate
+    writes).  ``n_trans`` sums ``n_new`` exactly as the stacked message
+    does."""
+    g = len(seqs)
+    t_total = seqs[0]["action"].shape[0]
+    frame_shape = seqs[0]["ep_frames"].shape[1:]
+    d = int(np.prod(frame_shape))
+    kf_max = g * t_total + 1
+    prios = np.stack([s.pop("priority") for s in seqs])
+    n_new = sum(s.pop("n_new") for s in seqs)
+
+    # union coverage per distinct episode array (identity keyed: the
+    # builder hands every window over one episode the SAME ndarray)
+    episodes: dict[int, list] = {}
+    for s in seqs:
+        k = id(s["ep_frames"])
+        e = episodes.get(k)
+        if e is None:
+            episodes[k] = [s["ep_frames"], s["start"], s["end"]]
+        else:
+            e[1] = min(e[1], s["start"])
+            e[2] = max(e[2], s["end"])
+
+    frames = np.zeros((kf_max, d), seqs[0]["ep_frames"].dtype)
+    base: dict[int, int] = {}
+    off = 1                          # row 0 = shared zero pad frame
+    for k, (arr, lo, hi) in episodes.items():
+        n = hi - lo
+        frames[off:off + n] = arr[lo:hi].reshape(n, d)
+        base[k] = off - lo           # message row of episode frame `lo`
+        off += n
+    assert off <= kf_max, (off, kf_max)   # coverage <= t_total per seq
+
+    obs_ref = np.zeros((g, t_total), np.int32)
+    for i, s in enumerate(seqs):
+        ln = s["end"] - s["start"]
+        b = base[id(s.pop("ep_frames"))]
+        obs_ref[i, :ln] = b + s.pop("start") + np.arange(ln, dtype=np.int32)
+        s.pop("end")                 # padded tail keeps ref 0 (zero row)
+
+    payload = dict(
+        frames=frames, n_frames=np.int32(off), n_seqs=np.int32(g),
+        obs_ref=obs_ref,
+        **{k: np.stack([s[k] for s in seqs]) for k in seqs[0]})
+    return {"payload": payload, "priorities": prios, "n_trans": int(n_new)}
+
+
+def drain_grouped(ready: list[dict], group: int,
+                  message_fn=sequence_message) -> list[dict]:
     """THE one group-batching drain: pop full groups of ``group``
     sequences off ``ready`` (in place) as fixed-shape messages; partial
     groups stay buffered for the next drain.  Shared by the scalar and
-    vector worker families and the single-process driver."""
+    vector worker families and the single-process driver.
+    ``message_fn`` picks the layout: :func:`sequence_message` (stacked)
+    or :func:`pooled_sequence_message` (frame-dedup pool)."""
     out = []
     while len(ready) >= group:
         take, ready[:] = ready[:group], ready[group:]
-        out.append(sequence_message(take))
+        out.append(message_fn(take))
     return out
 
 
@@ -57,7 +123,8 @@ class R2D2WorkerFamily:
         from apex_tpu.envs.registry import make_env
         from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
                                                make_recurrent_policy_fn)
-        from apex_tpu.training.r2d2 import SequenceBuilder
+        from apex_tpu.training.r2d2 import (SequenceBuilder,
+                                            r2d2_uses_frame_pool)
 
         self.seed = seed
         self.env = make_env(cfg.env.env_id, cfg.env, seed=seed,
@@ -65,9 +132,13 @@ class R2D2WorkerFamily:
         self.model = RecurrentDuelingDQN(**model_spec)
         self.policy = jax.jit(make_recurrent_policy_fn(self.model))
         rc = cfg.r2d2
+        pooled = r2d2_uses_frame_pool(cfg, self.env.observation_space.shape)
+        self.message_fn = (pooled_sequence_message if pooled
+                           else sequence_message)
         self.builder = SequenceBuilder(rc.burn_in, rc.unroll,
                                        cfg.learner.n_steps,
-                                       cfg.learner.gamma, stride=rc.stride)
+                                       cfg.learner.gamma, stride=rc.stride,
+                                       pooled=pooled)
         self.group = group
         self.carry = self.model.initial_state(1)
         self._ready: list[dict] = []
@@ -96,7 +167,7 @@ class R2D2WorkerFamily:
         return next_obs, float(reward), bool(term), bool(trunc)
 
     def poll_msgs(self) -> list[dict]:
-        return drain_grouped(self._ready, self.group)
+        return drain_grouped(self._ready, self.group, self.message_fn)
 
 
 def r2d2_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
@@ -127,7 +198,8 @@ class VectorR2D2WorkerFamily:
         from apex_tpu.actors.vector import VectorFamilyBase
         from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
                                                make_recurrent_policy_fn)
-        from apex_tpu.training.r2d2 import SequenceBuilder
+        from apex_tpu.training.r2d2 import (SequenceBuilder,
+                                            r2d2_uses_frame_pool)
 
         # composition over inheritance for the base: __init__ calls
         # _make_env before our model exists, so wire hooks explicitly
@@ -149,9 +221,14 @@ class VectorR2D2WorkerFamily:
         self.policy = jax.jit(make_recurrent_policy_fn(self.model))
         self.carry = self.model.initial_state(self.base.n_envs)
         rc = cfg.r2d2
+        pooled = r2d2_uses_frame_pool(
+            cfg, self.base.envs[0].observation_space.shape)
+        self.message_fn = (pooled_sequence_message if pooled
+                           else sequence_message)
         self.builders = [
             SequenceBuilder(rc.burn_in, rc.unroll, cfg.learner.n_steps,
-                            cfg.learner.gamma, stride=rc.stride)
+                            cfg.learner.gamma, stride=rc.stride,
+                            pooled=pooled)
             for _ in range(self.base.n_envs)]
         self.group = group
         self._ready: list[dict] = []
@@ -205,7 +282,7 @@ class VectorR2D2WorkerFamily:
         return stats
 
     def poll_msgs(self) -> list[dict]:
-        return drain_grouped(self._ready, self.group)
+        return drain_grouped(self._ready, self.group, self.message_fn)
 
 
 def vector_r2d2_worker_main(actor_id: int, cfg: ApexConfig,

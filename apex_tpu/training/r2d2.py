@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from apex_tpu.actors.r2d2 import drain_grouped
+from apex_tpu.actors.r2d2 import (drain_grouped, pooled_sequence_message,
+                                  sequence_message)
 from apex_tpu.config import ApexConfig
 from apex_tpu.envs.registry import make_env, make_eval_env, num_actions
 from apex_tpu.models.recurrent import (RecurrentDuelingDQN,
@@ -66,11 +67,17 @@ class SequenceBuilder:
     """
 
     def __init__(self, burn_in: int, unroll: int, n_steps: int,
-                 gamma: float, stride: int | None = None):
+                 gamma: float, stride: int | None = None,
+                 pooled: bool = False):
         self.burn_in, self.unroll, self.n_steps = burn_in, unroll, n_steps
         self.t_total = burn_in + unroll + n_steps
         self.stride = stride or max(1, unroll // 2)
         self.gamma = gamma
+        # pooled: emit frame REFERENCES for the dedup sequence frame-pool
+        # layout (apex_tpu/replay/seq_pool.py) — sequences share one
+        # episode frame array instead of each copying its padded window;
+        # pooled_sequence_message packs the shared frames once per message
+        self.pooled = pooled
         self._obs: list = []
         self._action: list = []
         self._reward: list = []
@@ -140,7 +147,6 @@ class SequenceBuilder:
                 break            # loss region entirely padded/masked
             c, h = self._carry[start]
             seq = dict(
-                obs=_pad(obs[start:end], pad),
                 action=_pad(np.asarray(self._action[start:end], np.int32),
                             pad),
                 reward=_pad(np.asarray(self._reward[start:end], np.float32),
@@ -151,6 +157,12 @@ class SequenceBuilder:
                 state_c=c.astype(np.float32),
                 state_h=h.astype(np.float32),
             )
+            if self.pooled:
+                # the episode array is SHARED by every window over it —
+                # the message packer ships each referenced frame once
+                seq["ep_frames"], seq["start"], seq["end"] = obs, start, end
+            else:
+                seq["obs"] = _pad(obs[start:end], pad)
             if td_full is not None:
                 td = _pad(td_full[start:end], pad)[
                     self.burn_in:self.burn_in + self.unroll] * lm
@@ -212,7 +224,7 @@ class R2D2Core:
     :func:`apex_tpu.training.learner.scan_fused_steps` applies)."""
 
     model: RecurrentDuelingDQN
-    replay: DeviceReplay
+    replay: object          # DeviceReplay | SequenceFramePoolReplay
     optimizer: optax.GradientTransformation
     batch_size: int = 64
     target_update_interval: int = 2500
@@ -287,6 +299,29 @@ def r2d2_model_spec(cfg: ApexConfig) -> dict:
     return r2d2_env_specs(cfg)[0]
 
 
+def r2d2_uses_frame_pool(cfg: ApexConfig, obs_shape) -> bool:
+    """THE one predicate deciding the recurrent family's storage layout —
+    shared by :func:`build_r2d2` and the worker families so the learner's
+    replay spec and the actors' message format cannot diverge.  Pooled
+    storage dedups pixel frames; vector observations stay on the stacked
+    layout (rows too small for the ring economics to matter)."""
+    return bool(cfg.replay.frame_pool) and len(obs_shape) == 3
+
+
+def r2d2_frame_capacity(cfg: ApexConfig) -> int:
+    """Frame-ring rows for the pooled sequence layout.  Each live
+    sequence references ~``stride`` frames new to it plus its share of
+    the cross-message window reshipping (``(t_total - stride)/group``
+    rows, :func:`apex_tpu.actors.r2d2.pooled_sequence_message`); 1.5x
+    headroom keeps the staleness redirect a measure-zero event under
+    episode-boundary jitter."""
+    rc, lc = cfg.r2d2, cfg.learner
+    t_total = rc.burn_in + rc.unroll + lc.n_steps
+    stride = rc.stride or max(1, rc.unroll // 2)
+    per_seq = stride + -(-(t_total - stride + 1) // rc.sequence_group)
+    return max(2 * t_total, int(1.5 * cfg.replay.capacity * per_seq))
+
+
 def build_r2d2(cfg: ApexConfig, key: jax.Array):
     """(model_spec, obs_shape, obs_dtype, model, replay, replay_state,
     train_state, core) — THE one definition of the family's replay item
@@ -298,20 +333,34 @@ def build_r2d2(cfg: ApexConfig, key: jax.Array):
     model = RecurrentDuelingDQN(**model_spec)
 
     t_total = rc.burn_in + rc.unroll + lc.n_steps
-    replay = DeviceReplay(capacity=cfg.replay.capacity,
-                          alpha=cfg.replay.alpha, eps=cfg.replay.eps)
-    example_item = dict(
-        obs=jnp.zeros((t_total,) + obs_shape, obs_dtype),
-        action=jnp.zeros(t_total, jnp.int32),
-        reward=jnp.zeros(t_total, jnp.float32),
-        discount=jnp.zeros(t_total, jnp.float32),
-        mask=jnp.zeros(t_total, jnp.float32),
-        state_c=jnp.zeros(rc.lstm_features, jnp.float32),
-        state_h=jnp.zeros(rc.lstm_features, jnp.float32))
-    check_hbm_budget(replay.hbm_bytes(example_item),
-                     cfg.replay.hbm_budget_gb,
-                     "R2D2 replay (sequence storage)", cfg.replay.capacity)
-    replay_state = replay.init(example_item)
+    if r2d2_uses_frame_pool(cfg, obs_shape):
+        from apex_tpu.replay.seq_pool import SequenceFramePoolReplay
+        replay = SequenceFramePoolReplay(
+            capacity=cfg.replay.capacity, t_total=t_total,
+            lstm_features=rc.lstm_features, frame_shape=tuple(obs_shape),
+            frame_capacity=r2d2_frame_capacity(cfg),
+            frame_dtype=str(np.dtype(obs_dtype)),
+            alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+        check_hbm_budget(replay.hbm_bytes(), cfg.replay.hbm_budget_gb,
+                         "R2D2 replay (pooled sequence storage)",
+                         cfg.replay.capacity)
+        replay_state = replay.init()
+    else:
+        replay = DeviceReplay(capacity=cfg.replay.capacity,
+                              alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+        example_item = dict(
+            obs=jnp.zeros((t_total,) + obs_shape, obs_dtype),
+            action=jnp.zeros(t_total, jnp.int32),
+            reward=jnp.zeros(t_total, jnp.float32),
+            discount=jnp.zeros(t_total, jnp.float32),
+            mask=jnp.zeros(t_total, jnp.float32),
+            state_c=jnp.zeros(rc.lstm_features, jnp.float32),
+            state_h=jnp.zeros(rc.lstm_features, jnp.float32))
+        check_hbm_budget(replay.hbm_bytes(example_item),
+                         cfg.replay.hbm_budget_gb,
+                         "R2D2 replay (sequence storage)",
+                         cfg.replay.capacity)
+        replay_state = replay.init(example_item)
 
     optimizer = make_optimizer(
         lr=lc.lr, decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
@@ -384,8 +433,13 @@ class R2D2Trainer(CheckpointableTrainer):
         self._ingest = self.core.jit_ingest()
         self._policy = jax.jit(make_recurrent_policy_fn(self.model))
 
+        from apex_tpu.replay.seq_pool import SequenceFramePoolReplay
+        self.pooled = isinstance(self.replay, SequenceFramePoolReplay)
+        self._message_fn = (pooled_sequence_message if self.pooled
+                            else sequence_message)
         self.builder = SequenceBuilder(rc.burn_in, rc.unroll, lc.n_steps,
-                                       lc.gamma, stride=rc.stride)
+                                       lc.gamma, stride=rc.stride,
+                                       pooled=self.pooled)
         self._pending: list[dict] = []
         self.transitions = 0
         self.ingest_group = rc.sequence_group
@@ -468,7 +522,8 @@ class R2D2Trainer(CheckpointableTrainer):
                 # no per-count retrace; remainders wait for the next
                 # episode's drain
                 self._pending.extend(self.builder.drain())
-                for msg in drain_grouped(self._pending, self.ingest_group):
+                for msg in drain_grouped(self._pending, self.ingest_group,
+                                         self._message_fn):
                     self.replay_state = self._ingest(
                         self.replay_state, msg["payload"],
                         jnp.asarray(msg["priorities"]))
@@ -563,7 +618,10 @@ class R2D2ApexTrainer(ConcurrentTrainer):
             group = rc.sequence_group
             t_total = rc.burn_in + rc.unroll + lc.n_steps
             obs_bytes = int(np.prod(obs_shape)) * np.dtype(obs_dtype).itemsize
-            slot = group * t_total * (obs_bytes + 16) \
+            # covers BOTH layouts: stacked ships G*T obs windows; pooled
+            # ships <= G*T+1 frame rows plus the i32 obs_ref table
+            slot = (group * t_total + 1) * obs_bytes \
+                + group * t_total * 24 \
                 + group * 8 * rc.lstm_features + 65536
             self.pool = ActorPool(cfg, self.model_spec,
                                   chunk_transitions=group,

@@ -1,0 +1,268 @@
+"""Frame-dedup SEQUENCE replay (apex_tpu/replay/seq_pool.py): stacked-vs-
+pooled bit parity, the capacity win, padding/staleness invariants, and the
+pooled pixel R2D2 driver mechanics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.actors.r2d2 import (drain_grouped, pooled_sequence_message,
+                                  sequence_message)
+from apex_tpu.config import small_test_config
+from apex_tpu.replay.device import DeviceReplay
+from apex_tpu.replay.seq_pool import SequenceFramePoolReplay
+from apex_tpu.training.r2d2 import SequenceBuilder
+
+BURN, UNROLL, NSTEP = 2, 4, 1
+T_TOTAL = BURN + UNROLL + NSTEP
+H = 8           # lstm features
+SHAPE = (6, 6, 1)
+
+
+def _feed_episodes(builder: SequenceBuilder, rng, n_eps=5,
+                   lengths=(9, 4, 15, 7, 12)):
+    """Identical synthetic episodes into any builder."""
+    for e in range(n_eps):
+        n = lengths[e % len(lengths)]
+        for t in range(n):
+            builder.add_step(
+                rng.integers(0, 255, SHAPE).astype(np.uint8),
+                int(rng.integers(0, 3)), float(rng.normal()),
+                terminated=(t == n - 1),
+                carry_c=rng.normal(size=H).astype(np.float32),
+                carry_h=rng.normal(size=H).astype(np.float32),
+                q_values=rng.normal(size=3).astype(np.float32))
+        builder.end_episode()
+
+
+def _builders_pair(seed=0):
+    """Two builders fed the SAME episode stream (same rng seed)."""
+    out = []
+    for pooled in (False, True):
+        b = SequenceBuilder(BURN, UNROLL, NSTEP, gamma=0.9, stride=3,
+                            pooled=pooled)
+        _feed_episodes(b, np.random.default_rng(seed))
+        out.append(b)
+    return out
+
+
+def test_pooled_message_parity_with_stacked():
+    """The pooled message carries EXACTLY the stacked message's content:
+    gathering frames[obs_ref] reproduces the stacked obs windows, all
+    other leaves and the priorities/n_trans accounting are identical."""
+    stacked_b, pooled_b = _builders_pair()
+    group = 4
+    stacked_msgs = drain_grouped(stacked_b.drain(), group)
+    pooled_msgs = drain_grouped(pooled_b.drain(), group,
+                                pooled_sequence_message)
+    assert len(stacked_msgs) == len(pooled_msgs) > 0
+    d = int(np.prod(SHAPE))
+    for sm, pm in zip(stacked_msgs, pooled_msgs):
+        np.testing.assert_array_equal(sm["priorities"], pm["priorities"])
+        assert sm["n_trans"] == pm["n_trans"]
+        sp, pp = sm["payload"], pm["payload"]
+        for k in ("action", "reward", "discount", "mask",
+                  "state_c", "state_h"):
+            np.testing.assert_array_equal(sp[k], pp[k])
+        # frame refs reconstruct the stacked windows bit-for-bit
+        rebuilt = pp["frames"][pp["obs_ref"].reshape(-1)].reshape(
+            group, T_TOTAL, *SHAPE)
+        np.testing.assert_array_equal(rebuilt, sp["obs"])
+        # row 0 is the shared zero pad frame; pad rows stay zero
+        assert not pp["frames"][0].any()
+        assert not pp["frames"][int(pp["n_frames"]):].any()
+
+
+def test_pooled_message_dedups_overlap():
+    """Overlapping windows (stride < t_total) share rows: the message
+    ships FEWER frame rows than the stacked windows' total."""
+    _, pooled_b = _builders_pair()
+    msgs = drain_grouped(pooled_b.drain(), 4, pooled_sequence_message)
+    for m in msgs:
+        assert int(m["payload"]["n_frames"]) < 4 * T_TOTAL + 1
+
+
+def _specs_pair(capacity=16):
+    stacked = DeviceReplay(capacity=capacity)
+    pooled = SequenceFramePoolReplay(
+        capacity=capacity, t_total=T_TOTAL, lstm_features=H,
+        frame_shape=SHAPE, frame_capacity=8 * capacity)
+    example = dict(
+        obs=jnp.zeros((T_TOTAL,) + SHAPE, jnp.uint8),
+        action=jnp.zeros(T_TOTAL, jnp.int32),
+        reward=jnp.zeros(T_TOTAL, jnp.float32),
+        discount=jnp.zeros(T_TOTAL, jnp.float32),
+        mask=jnp.zeros(T_TOTAL, jnp.float32),
+        state_c=jnp.zeros(H, jnp.float32),
+        state_h=jnp.zeros(H, jnp.float32))
+    return stacked, stacked.init(example), pooled, pooled.init()
+
+
+def test_pooled_sample_parity_with_stacked():
+    """Same episode stream, same ingest order, same sampling key: the
+    pooled layout returns the stacked layout's exact batch (obs included)
+    and identical IS weights."""
+    stacked_b, pooled_b = _builders_pair()
+    group = 4
+    s_spec, s_state, p_spec, p_state = _specs_pair()
+    s_msgs = drain_grouped(stacked_b.drain(), group)
+    p_msgs = drain_grouped(pooled_b.drain(), group,
+                           pooled_sequence_message)
+    for sm, pm in zip(s_msgs, p_msgs):
+        s_state = s_spec.add(
+            s_state, {k: jnp.asarray(v) for k, v in sm["payload"].items()},
+            jnp.asarray(sm["priorities"]))
+        p_state = p_spec.add(
+            p_state, {k: jnp.asarray(v) for k, v in pm["payload"].items()},
+            jnp.asarray(pm["priorities"]))
+
+    key = jax.random.key(3)
+    sb, sw, si = s_spec.sample(s_state, key, 8, 0.5)
+    pb, pw, pi = p_spec.sample(p_state, key, 8, 0.5)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(pi))
+    for k in sb:
+        np.testing.assert_array_equal(
+            np.asarray(sb[k]), np.asarray(pb[k]), err_msg=k)
+    np.testing.assert_allclose(np.asarray(sw), np.asarray(pw), rtol=1e-6)
+
+    # priority write-back keeps the trees in lockstep too
+    new_p = jnp.abs(jax.random.normal(jax.random.key(4), (8,))) + 0.1
+    s_state = s_spec.update_priorities(s_state, si, new_p)
+    p_state = p_spec.update_priorities(p_state, pi, new_p)
+    np.testing.assert_allclose(np.asarray(s_state.sum_tree),
+                               np.asarray(p_state.sum_tree), rtol=1e-6)
+
+
+def test_padded_tail_gathers_zero_frames():
+    """A short episode's padded positions (mask 0) must sample as all-zero
+    frames — exactly what the stacked layout stores there."""
+    b = SequenceBuilder(BURN, UNROLL, NSTEP, gamma=0.9, stride=3,
+                        pooled=True)
+    rng = np.random.default_rng(7)
+    for t in range(BURN + 2):                 # shorter than t_total
+        b.add_step(rng.integers(1, 255, SHAPE).astype(np.uint8),
+                   0, 0.0, terminated=(t == BURN + 1),
+                   carry_c=np.zeros(H, np.float32),
+                   carry_h=np.zeros(H, np.float32))
+    b.end_episode()
+    seqs = b.drain()
+    assert len(seqs) == 1
+    msg = pooled_sequence_message(seqs)
+    p_spec = SequenceFramePoolReplay(capacity=4, t_total=T_TOTAL,
+                                     lstm_features=H, frame_shape=SHAPE,
+                                     frame_capacity=64)
+    state = p_spec.add(p_spec.init(),
+                       {k: jnp.asarray(v)
+                        for k, v in msg["payload"].items()},
+                       jnp.asarray(msg["priorities"]))
+    batch, _, _ = p_spec.sample(state, jax.random.key(0), 4, 0.4)
+    obs = np.asarray(batch["obs"])
+    mask = np.asarray(batch["mask"])
+    n_real = BURN + 2
+    assert (obs[:, :n_real] > 0).any()
+    assert not obs[:, n_real:].any(), "padded positions must be zero"
+    assert not mask[:, n_real:].any()
+
+
+def test_frame_ring_wrap_and_staleness_redirect():
+    """Ingesting far past frame_capacity: old sequences whose frames aged
+    out redirect to the newest slot at sample time (graceful, never
+    corrupt), and fresh sequences still reconstruct exactly."""
+    p_spec = SequenceFramePoolReplay(capacity=8, t_total=T_TOTAL,
+                                     lstm_features=H, frame_shape=SHAPE,
+                                     frame_capacity=2 * T_TOTAL + 3)
+    state = p_spec.init()
+    rng = np.random.default_rng(1)
+    b = SequenceBuilder(BURN, UNROLL, NSTEP, gamma=0.9, stride=3,
+                        pooled=True)
+    last_payload = None
+    for e in range(6):
+        for t in range(T_TOTAL):
+            b.add_step(rng.integers(0, 255, SHAPE).astype(np.uint8),
+                       0, 0.0, terminated=(t == T_TOTAL - 1),
+                       carry_c=np.zeros(H, np.float32),
+                       carry_h=np.zeros(H, np.float32))
+        b.end_episode()
+        for msg in drain_grouped(b.drain(), 2, pooled_sequence_message):
+            last_payload = msg["payload"]
+            state = p_spec.add(
+                state,
+                {k: jnp.asarray(v) for k, v in last_payload.items()},
+                jnp.asarray(msg["priorities"]))
+    batch, _, idx = p_spec.sample(state, jax.random.key(2), 16, 0.4)
+    obs = np.asarray(batch["obs"])
+    assert np.isfinite(np.asarray(batch["reward"])).all()
+    # the newest slot's first real frame must appear verbatim for any
+    # redirected row; every row decodes without corruption
+    newest = int((state.pos - 1) % p_spec.capacity)
+    ref = last_payload["frames"][last_payload["obs_ref"][-1, 0]]
+    got = obs[np.asarray(idx) == newest]
+    if got.size:
+        np.testing.assert_array_equal(
+            got[0, 0].reshape(-1), ref)
+
+
+def test_capacity_win_vs_stacked():
+    """The point of the layout: at a realistic R2D2 geometry the pooled
+    spec stores the same number of live sequences in a fraction of the
+    stacked HBM (the stacked layout repeats every frame ~t_total/stride
+    times across overlapping windows)."""
+    cap, t_total, lstm = 1024, 125, 512     # R2D2-paper-scale sequences
+    stride, group = 40, 16
+    per_seq = stride + -(-(t_total - stride + 1) // group)
+    pooled = SequenceFramePoolReplay(
+        capacity=cap, t_total=t_total, lstm_features=lstm,
+        frame_shape=(84, 84, 1),
+        frame_capacity=int(1.5 * cap * per_seq))
+    stacked = DeviceReplay(capacity=cap)
+    example = dict(
+        obs=jnp.zeros((t_total, 84, 84, 1), jnp.uint8),
+        action=jnp.zeros(t_total, jnp.int32),
+        reward=jnp.zeros(t_total, jnp.float32),
+        discount=jnp.zeros(t_total, jnp.float32),
+        mask=jnp.zeros(t_total, jnp.float32),
+        state_c=jnp.zeros(lstm, jnp.float32),
+        state_h=jnp.zeros(lstm, jnp.float32))
+    ratio = stacked.hbm_bytes(example) / pooled.hbm_bytes()
+    assert ratio > 1.6, f"expected a >1.6x capacity win, got {ratio:.2f}x"
+
+
+@pytest.mark.slow
+def test_r2d2_pixel_pooled_driver_mechanics():
+    """The pooled layout end to end in the single-process pixel driver:
+    cfg.replay.frame_pool=True routes the recurrent family onto
+    SequenceFramePoolReplay (builder, messages, fused ingest, sampling,
+    eval) — a few training steps prove the plumbing."""
+    from apex_tpu.training.r2d2 import R2D2Trainer
+
+    cfg = small_test_config(capacity=256, batch_size=8,
+                            env_id="ApexCatchSmall-v0")
+    cfg = cfg.replace(replay=dataclasses.replace(cfg.replay,
+                                                 frame_pool=True))
+    t = R2D2Trainer(cfg)
+    assert t.pooled and isinstance(t.replay, SequenceFramePoolReplay)
+    t.train(total_frames=700, log_every=10 ** 9, warmup_sequences=8)
+    assert t.steps_rate.total > 0
+    assert t.sequences >= 8
+    assert np.isfinite(t.evaluate(episodes=1, max_steps=30))
+
+
+@pytest.mark.slow
+def test_r2d2_apex_pooled_concurrent_mechanics():
+    """Concurrent pooled R2D2: worker processes build POOLED sequence
+    messages (the shared frame-pool predicate picks the layout on both
+    sides) and the learner ingests them through the fused step."""
+    from apex_tpu.training.r2d2 import R2D2ApexTrainer
+
+    cfg = small_test_config(capacity=512, batch_size=8, n_actors=1,
+                            env_id="ApexCatchSmall-v0")
+    cfg = cfg.replace(replay=dataclasses.replace(cfg.replay,
+                                                 frame_pool=True))
+    t = R2D2ApexTrainer(cfg, publish_min_seconds=0.05)
+    assert isinstance(t.replay, SequenceFramePoolReplay)
+    t.train(total_steps=10, max_seconds=240)
+    assert t.steps_rate.total >= 10
+    assert all(not p.is_alive() for p in t.pool.procs)
