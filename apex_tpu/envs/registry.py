@@ -82,19 +82,27 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
     elif env_id.startswith("ApexContinuousNav"):
         env = (toy.ContinuousNavEnv(max_episode_steps=max_episode_steps)
                if max_episode_steps is not None else toy.ContinuousNavEnv())
-    elif env_id.startswith("ApexCatch"):
-        # Small variant: 7x7 grid rendered to 42x42 (smallest input the
-        # Nature conv geometry accepts), 3 balls — a CI-scale task the conv
-        # path can crack in a few thousand updates (6-step credit horizon).
-        # Medium: 11x11 at 44x44, 4 balls — a 10-step credit horizon, the
-        # harder pixel learning certificate standing in for ALE (absent
-        # from this image; ROUND4_NOTES.md).
-        if "Small" in env_id:
-            env = toy.CatchEnv(grid=7, pixels=42, balls=3)
-        elif "Medium" in env_id:
-            env = toy.CatchEnv(grid=11, pixels=44, balls=4)
+    elif env_id.startswith(("ApexCatch", "ApexRally")):
+        # Pixel toy envs.  Catch — Small: 7x7 grid rendered to 42x42
+        # (smallest input the Nature conv geometry accepts), 3 balls (a
+        # 6-step credit horizon); Medium: 11x11 at 44x44, 4 balls (a
+        # 10-step horizon, the harder pixel certificate standing in for
+        # ALE, absent from this image; ROUND4_NOTES.md).  Rally — the
+        # Pong-shaped ADVERSARIAL task (scripted opponent, edge-shot
+        # mechanic — toy.RallyEnv); Small: 14-cell court at 42x42, 2
+        # points (the CI-scale certificate); full: 21 at 84x84, 3 points
+        # (the flagship-geometry stand-in for ALE Pong).
+        if env_id.startswith("ApexCatch"):
+            if "Small" in env_id:
+                env = toy.CatchEnv(grid=7, pixels=42, balls=3)
+            elif "Medium" in env_id:
+                env = toy.CatchEnv(grid=11, pixels=44, balls=4)
+            else:
+                env = toy.CatchEnv()
         else:
-            env = toy.CatchEnv()
+            env = (toy.RallyEnv(grid=14, pixels=42, points=2)
+                   if "Small" in env_id else toy.RallyEnv())
+        # ONE copy of the pixel wrapper tail for every toy pixel env
         if max_episode_steps is not None:
             env = wrappers.TimeLimit(env, max_episode_steps)
         if stack_frames and cfg.frame_stack > 1:

@@ -130,6 +130,127 @@ class ContinuousNavEnv(gym.Env):
         return self._pos.astype(np.float32), reward, False, truncated, {}
 
 
+class RallyEnv(gym.Env):
+    """Two-paddle rally against a scripted opponent — the Pong-shaped
+    pixel task (ALE is absent from this image; ``origin_repo/create_env.sh:5``
+    / ``wrapper.py:257`` assume it).  Unlike :class:`CatchEnv`'s drop-and-
+    catch loop, this has OPPONENT DYNAMICS and long multi-rally credit
+    horizons: points are scored tens of steps after the stroke that won
+    them, and beating the opponent requires discovering the edge-shot
+    mechanic rather than just tracking the ball.
+
+    Court: ``grid x grid`` cells, rendered to ``pixels x pixels x 1``
+    uint8.  The opponent guards column 0, the agent column ``grid-1``;
+    actions 0=stay, 1=up, 2=down.  The ball advances one column per step;
+    vertical speed is set by WHERE it strikes a paddle (center -> shallow,
+    edge -> steep, the classic Pong deflection) and reflects off the
+    walls.  The opponent tracks the incoming ball at speed 1 — it returns
+    every shallow ball, but an edge hit sends the ball at |vy| = 1.75,
+    which outruns it across the court: the agent must learn to RECEIVE
+    anywhere and STRIKE with its paddle edge.  Reward +1 when the
+    opponent misses, -1 when the agent does; an episode is ``points``
+    points (eval metric = score differential, the reference's unclipped
+    eval convention, ``origin_repo/eval.py:49-87``).
+    """
+
+    metadata: dict = {}
+
+    MAX_VY = 1.75          # edge-hit deflection; outruns the speed-1 opponent
+    MIN_VY = 0.5           # center hits stay live (no horizontal stalemates)
+
+    def __init__(self, grid: int = 21, pixels: int = 84, points: int = 3,
+                 paddle_half: int = 1):
+        self.grid, self.pixels, self.points = grid, pixels, points
+        self.half = paddle_half
+        self.observation_space = gym.spaces.Box(0, 255, (pixels, pixels, 1),
+                                                np.uint8)
+        self.action_space = gym.spaces.Discrete(3)
+        self._scale = pixels // grid
+
+    # -- mechanics ---------------------------------------------------------
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        self._agent_y = self._opp_y = (self.grid - 1) / 2
+        self._played = 0
+        self._serve(toward_agent=bool(self.np_random.random() < 0.5))
+        return self._render(), {}
+
+    def _serve(self, toward_agent: bool) -> None:
+        self._bx = (self.grid - 1) / 2
+        self._by = float(self.np_random.integers(2, self.grid - 2))
+        self._vx = 1 if toward_agent else -1
+        self._vy = float(self.np_random.choice([-1.0, -0.5, 0.5, 1.0]))
+
+    def _deflect(self, offset: float) -> float:
+        """Paddle-contact vertical speed from the normalized hit offset
+        (center 0 -> shallow, edge +-1 -> MAX_VY steep)."""
+        vy = self.MAX_VY * offset
+        if abs(vy) < self.MIN_VY:
+            sign = 1.0 if self.np_random.random() < 0.5 else -1.0
+            vy = self.MIN_VY * sign
+        return float(np.clip(vy, -self.MAX_VY, self.MAX_VY))
+
+    def step(self, action):
+        g, half = self.grid, self.half
+        # agent paddle
+        self._agent_y = float(np.clip(
+            self._agent_y + (0, -1, 1)[int(action)], half, g - 1 - half))
+        # scripted opponent: track the ball at speed 1 at ALL times (a
+        # re-centering opponent loses to plain tracking — measured; this
+        # one only loses to deliberately generated steep angles)
+        self._opp_y = float(np.clip(
+            self._opp_y + np.clip(self._by - self._opp_y, -1.0, 1.0),
+            half, g - 1 - half))
+        # ball advance + wall reflection
+        self._bx += self._vx
+        self._by += self._vy
+        while self._by < 0 or self._by > g - 1:
+            if self._by < 0:
+                self._by = -self._by
+            else:
+                self._by = 2 * (g - 1) - self._by
+            self._vy = -self._vy
+
+        reward = 0.0
+        if self._bx <= 0:                       # opponent's goal column
+            if abs(self._by - self._opp_y) <= half + 0.5:
+                self._bx, self._vx = 0.0, 1
+                self._vy = self._deflect(
+                    (self._by - self._opp_y) / (half + 0.5))
+            else:
+                reward = 1.0
+                self._played += 1
+                self._serve(toward_agent=False)
+        elif self._bx >= g - 1:                 # agent's goal column
+            if abs(self._by - self._agent_y) <= half + 0.5:
+                self._bx, self._vx = float(g - 1), -1
+                self._vy = self._deflect(
+                    (self._by - self._agent_y) / (half + 0.5))
+            else:
+                reward = -1.0
+                self._played += 1
+                self._serve(toward_agent=True)
+        terminated = self._played >= self.points
+        return self._render(), reward, terminated, False, {}
+
+    # -- rendering ---------------------------------------------------------
+
+    def _block(self, img, row: float, col: int, h: int, value: int) -> None:
+        s = self._scale
+        r0 = int(np.clip(round(row) - h, 0, self.grid - 1)) * s
+        r1 = (int(np.clip(round(row) + h, 0, self.grid - 1)) + 1) * s
+        img[r0:r1, col * s:(col + 1) * s] = value
+
+    def _render(self) -> np.ndarray:
+        img = np.zeros((self.pixels, self.pixels, 1), np.uint8)
+        self._block(img, self._opp_y, 0, self.half, 128)
+        self._block(img, self._agent_y, self.grid - 1, self.half, 128)
+        bx = int(np.clip(round(self._bx), 0, self.grid - 1))
+        self._block(img, self._by, bx, 0, 255)
+        return img
+
+
 class CatchEnv(gym.Env):
     """Catch a falling ball with a paddle; pixel observations.
 

@@ -1,0 +1,156 @@
+"""RallyEnv — the Pong-shaped adversarial pixel task (ALE stand-in):
+mechanics, deflection physics, and the measured strategy ladder that makes
+it a real certificate (random loses, tracking ~breaks even, edge-shot play
+wins every point)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.envs.registry import make_env
+from apex_tpu.envs.toy import RallyEnv
+
+
+def test_spaces_and_render():
+    env = RallyEnv(grid=14, pixels=42, points=2)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (42, 42, 1) and obs.dtype == np.uint8
+    assert (obs == 255).any(), "ball not rendered"
+    assert (obs == 128).any(), "paddles not rendered"
+    # both goal columns carry a paddle
+    assert (obs[:, :3] == 128).any() and (obs[:, -3:] == 128).any()
+
+
+def test_registry_ids_and_stack():
+    env = make_env("ApexRallySmall-v0", stack_frames=False)
+    assert env.observation_space.shape == (42, 42, 1)
+    stacked = make_env("ApexRallySmall-v0")       # default frame_stack=4
+    assert stacked.observation_space.shape == (42, 42, 4)
+    full = make_env("ApexRally-v0", stack_frames=False)
+    assert full.observation_space.shape == (84, 84, 1)
+
+
+def test_wall_reflection_keeps_ball_in_court():
+    env = RallyEnv(grid=14, pixels=42, points=4)
+    env.reset(seed=1)
+    env._by, env._vy = 1.0, -RallyEnv.MAX_VY          # heading off the top
+    for _ in range(50):
+        env.step(0)
+        assert 0 <= env._by <= env.grid - 1
+
+
+def test_deflection_center_vs_edge():
+    env = RallyEnv(grid=14, pixels=42, points=2)
+    env.reset(seed=2)
+    assert abs(env._deflect(0.0)) == RallyEnv.MIN_VY   # no stalemates
+    assert env._deflect(1.0) == RallyEnv.MAX_VY
+    assert env._deflect(-1.0) == -RallyEnv.MAX_VY
+
+
+def test_scoring_and_episode_termination():
+    env = RallyEnv(grid=14, pixels=42, points=2)
+    env.reset(seed=3)
+    # park the agent away from the incoming ball: every point is a miss
+    total, rewards = 0, []
+    env._vx, env._bx, env._by, env._vy = 1, 5.0, 2.0, 0.0
+    env._agent_y = env.grid - 2.0
+    done = False
+    steps = 0
+    while not done and steps < 500:
+        _, r, done, trunc, _ = env.step(0)
+        if r:
+            rewards.append(r)
+        # keep parking the paddle far from the rally line
+        env._agent_y = env.grid - 2.0
+        steps += 1
+    assert rewards.count(-1.0) >= 1
+    assert done and env._played == 2
+
+
+# -- the strategy ladder (what makes this env a certificate) ---------------
+
+def _run(policy, episodes=40, seed=0):
+    env = RallyEnv(grid=14, pixels=42, points=2)
+    rng = np.random.default_rng(seed)
+    scores = []
+    for ep in range(episodes):
+        env.reset(seed=seed + ep)
+        total, done, steps = 0.0, False, 0
+        while not done and steps < 2000:
+            _, r, done, _, _ = env.step(policy(env, rng))
+            total += r
+            steps += 1
+        scores.append(total)
+    return float(np.mean(scores))
+
+
+def _toward(env, target):
+    d = target - env._agent_y
+    return 0 if abs(d) < 0.5 else (2 if d > 0 else 1)
+
+
+def _predict_arrival(env):
+    g = env.grid
+    steps = (g - 1) - env._bx if env._vx > 0 else 2 * (g - 1) - env._bx
+    y = (env._by + env._vy * steps) % (2 * (g - 1))
+    return 2 * (g - 1) - y if y > g - 1 else y
+
+
+def _edge_policy(env, rng):
+    g = env.grid
+    arr = _predict_arrival(env)
+    if env._vx > 0 and (g - 1) - env._bx <= 3:
+        sign = 1.0 if env._opp_y < (g - 1) / 2 else -1.0
+        return _toward(env, arr - sign * env.half)   # strike with the edge
+    return _toward(env, arr)
+
+
+def test_strategy_ladder_random_loses_edge_wins():
+    """The adversarial structure, measured: random play loses clearly;
+    the edge-shot strategy (predict arrival, strike with the paddle edge
+    to steer away from the opponent) wins essentially every point —
+    proof that beating the speed-1 tracking opponent is achievable
+    through the deflection mechanic within the action space."""
+    random_score = _run(lambda env, rng: int(rng.integers(0, 3)))
+    edge_score = _run(_edge_policy)
+    assert random_score < -0.5, f"random unexpectedly strong: {random_score}"
+    assert edge_score > 1.5, f"edge strategy should dominate: {edge_score}"
+
+
+@pytest.mark.slow
+def test_apex_learns_rally_small(tmp_path):
+    """THE adversarial pixel certificate (VERDICT r4 item 6): DQN through
+    the full concurrent pipeline must BEAT the scripted opponent on net
+    (score > 0 over evaluation episodes).  Context for the bar, measured
+    at this geometry: random play -1.45, plain ball-tracking +0.57, the
+    edge-shot strategy +2.0 — a >0 score requires real receiving skill;
+    the gap to +2 is deflection mastery.  Scored over retained
+    checkpoints like the other learning certificates (eval convention:
+    origin_repo/eval.py:49-87)."""
+    import dataclasses
+
+    from apex_tpu.config import small_test_config
+    from apex_tpu.training.apex import ApexTrainer
+    from apex_tpu.training.checkpoint import evaluate_checkpoint
+
+    cfg = small_test_config(capacity=8192, batch_size=32, n_actors=3,
+                            env_id="ApexRallySmall-v0")
+    cfg = cfg.replace(
+        env=dataclasses.replace(cfg.env, frame_stack=4),
+        actor=dataclasses.replace(cfg.actor, eps_anneal_steps=2000,
+                                  eps_alpha=3.0),
+        learner=dataclasses.replace(cfg.learner, gamma=0.98,
+                                    target_update_interval=150,
+                                    save_interval=600))
+    trainer = ApexTrainer(cfg, publish_min_seconds=0.05, train_ratio=8.0,
+                          min_train_ratio=1.0,
+                          checkpoint_dir=str(tmp_path / "ck"))
+    trainer.checkpointer.keep = 20
+    trainer.train(total_steps=12000, max_seconds=1800)
+
+    scores = [trainer.evaluate(episodes=6, epsilon=0.0, max_steps=400)]
+    for name in trainer.checkpointer._all():
+        scores.append(evaluate_checkpoint(str(tmp_path / "ck" / name),
+                                          episodes=6, max_steps=400))
+    best = max(scores)
+    assert best > 0.0, (f"best rally policy scored {best} <= 0: not "
+                        f"beating the scripted opponent")
