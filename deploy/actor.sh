@@ -2,10 +2,16 @@
 # Actor-node bootstrap (reference origin_repo/deploy/actor.sh:4-9): one tmux
 # session per actor process, global ACTOR_ID = node_id * per_node + idx.
 set -euo pipefail
+# stock Ubuntu ships without git — the clone below needs it before the
+# in-repo provision script (which installs everything else) is reachable
+command -v git >/dev/null || (apt-get update && apt-get install -y git)
 cd /opt
 git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
 cd apex-tpu
-pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+# Baked image (deploy/packer): /opt/apex-env already provisioned; a fresh
+# VM provisions on first boot (idempotence marker makes respawns free).
+[ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
+/opt/apex-env/bin/pip install -e . --no-deps
 
 # Supervisor loop: a crashed actor is relaunched after a short backoff —
 # the role's join path (runtime/roles.py:_join_fleet, transport.barrier_wait
@@ -22,7 +28,7 @@ while [ $idx -lt ${actors_per_node} ]; do
        start=\$(date +%s); \
        JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
        N_ENVS_PER_ACTOR=${envs_per_actor} \
-       LEARNER_IP=${learner_ip} python -m apex_tpu.runtime \
+       LEARNER_IP=${learner_ip} /opt/apex-env/bin/python -m apex_tpu.runtime \
        --env-id ${env_id} --barrier-timeout 1800; \
        rc=\$?; \
        if [ \$(( \$(date +%s) - start )) -gt 60 ]; then fails=0; fi; \

@@ -2,10 +2,13 @@
 # Evaluator bootstrap (reference origin_repo/deploy/evaluator.sh): greedy
 # unclipped scoring streamed from the learner's param PUB.
 set -euo pipefail
+command -v git >/dev/null || (apt-get update && apt-get install -y git)
 cd /opt
 git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
 cd apex-tpu
-pip install -e . pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+# Baked image (deploy/packer) or first-boot provisioning — see actor.sh.
+[ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
+/opt/apex-env/bin/pip install -e . --no-deps
 
 # Supervisor loop mirrors deploy/actor.sh: crashed evaluators respawn
 # (rejoining via the param stream once the startup barrier is gone);
@@ -14,7 +17,7 @@ tmux new -s evaluator -d \
   "fails=0; \
    while true; do \
      start=\$(date +%s); \
-     JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
+     JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs /opt/apex-env/bin/python -m apex_tpu.runtime \
      --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
      --barrier-timeout 1800 --verbose; \
      rc=\$?; \
@@ -23,4 +26,4 @@ tmux new -s evaluator -d \
      if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
      echo \"evaluator exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \
    done; read"
-tmux new -s tensorboard -d "tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"
+tmux new -s tensorboard -d "/opt/apex-env/bin/tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

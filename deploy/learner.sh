@@ -3,14 +3,19 @@
 # launch the learner role in tmux.  Runs on the TPU VM; jax[tpu] drives the
 # local slice as an n-chip dp mesh.
 set -euo pipefail
+command -v git >/dev/null || (apt-get update && apt-get install -y git)
 cd /opt
 git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
 cd apex-tpu
-pip install -e . 'jax[tpu]' pyzmq tensorboardX gymnasium "ale-py" opencv-python-headless
+# TPU VMs boot vendor runtime images (no custom Packer image possible),
+# so the learner provisions the pinned env at FIRST boot; the idempotence
+# marker (deploy/provision.sh) makes later respawns free.
+[ -f /opt/apex-env/.provisioned-tpu ] || bash deploy/provision.sh tpu
+/opt/apex-env/bin/pip install -e . --no-deps
 
 # --mesh-dp defaults to 0 = all local chips; the runtime counts them itself
-tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs python -m apex_tpu.runtime \
+tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs /opt/apex-env/bin/python -m apex_tpu.runtime \
   --role learner --env-id ${env_id} --n-actors ${n_actors} \
   --batch-size 512 --train-ratio 16 --min-train-ratio 2 \
   --checkpoint-dir /opt/apex-tpu/ckpts --barrier-timeout 1800 --verbose; read"
-tmux new -s tensorboard -d "tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"
+tmux new -s tensorboard -d "/opt/apex-env/bin/tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

@@ -66,7 +66,7 @@ resource "google_compute_instance" "actor" {
 
   boot_disk {
     initialize_params {
-      image = "ubuntu-os-cloud/ubuntu-2204-lts"
+      image = var.fleet_image
       size  = 50
     }
   }
@@ -96,7 +96,7 @@ resource "google_compute_instance" "evaluator" {
 
   boot_disk {
     initialize_params {
-      image = "ubuntu-os-cloud/ubuntu-2204-lts"
+      image = var.fleet_image
       size  = 50
     }
   }

@@ -1,0 +1,55 @@
+# Baked fleet images — the reference's Packer AMI flow
+# (origin_repo/deploy/packer/ape_x_actor.json + ape_x_cpu.sh) re-designed
+# for GCP: one googlecompute build bakes the pinned /opt/apex-env
+# (deploy/provision.sh) into an image family the Terraform fleet boots
+# from (variables.tf: fleet_image).
+#
+#   packer init  deploy/packer
+#   packer build -var project=$PROJECT deploy/packer
+#
+# Only the CPU fleet (actors + evaluator) is baked: GCP TPU VMs boot
+# vendor runtime images selected by runtime_version and cannot use custom
+# images, so the learner runs provision.sh tpu at first boot instead
+# (learner.sh; the idempotence marker makes respawns free).
+
+packer {
+  required_plugins {
+    googlecompute = {
+      version = ">= 1.1"
+      source  = "github.com/hashicorp/googlecompute"
+    }
+  }
+}
+
+variable "project" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central2-b"
+}
+
+source "googlecompute" "apex_cpu" {
+  project_id          = var.project
+  zone                = var.zone
+  source_image_family = "ubuntu-2204-lts"
+  image_name          = "apex-tpu-cpu-{{timestamp}}"
+  image_family        = "apex-tpu-cpu"
+  machine_type        = "n2-standard-4"
+  disk_size           = 50
+  ssh_username        = "ubuntu"
+}
+
+build {
+  sources = ["source.googlecompute.apex_cpu"]
+
+  provisioner "file" {
+    source      = "${path.root}/../provision.sh"
+    destination = "/tmp/provision.sh"
+  }
+
+  provisioner "shell" {
+    inline = ["sudo bash /tmp/provision.sh cpu"]
+  }
+}

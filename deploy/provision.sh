@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Image provisioning — the Packer-analogue of the reference's conda bake
+# (origin_repo/deploy/packer/ape_x_cpu.sh / ape_x_gpu.sh, invoked from the
+# per-role packer JSONs).  Bakes a PINNED Python env at /opt/apex-env so
+# fleet nodes boot into a known-good interpreter instead of resolving
+# dependencies at startup (the reference's AMIs exist for the same reason:
+# a 192-actor fleet cold-resolving pip deps is slow and version-skewed).
+#
+# One script, parametrized by accelerator (the reference keeps two copies):
+#   provision.sh cpu   # actor / evaluator nodes (jax CPU wheel)
+#   provision.sh tpu   # learner TPU VM (jax[tpu] + libtpu)
+#
+# Idempotent: a marker short-circuits re-runs, so the same script serves
+# BOTH paths — baked into an image by deploy/packer/apex_images.pkr.hcl
+# (CPU fleet), or run at first boot by the role bootstraps (TPU VM:
+# GCP TPU VMs boot vendor runtime images selected via runtime_version and
+# cannot boot custom Packer images, so the learner provisions on first
+# startup and respawns hit the marker).
+set -euo pipefail
+
+ACCEL="${1:-cpu}"
+ENV_DIR=/opt/apex-env
+MARKER="$ENV_DIR/.provisioned-$ACCEL"
+
+if [ -f "$MARKER" ]; then
+  echo "provision: $MARKER present, env already baked"
+  exit 0
+fi
+
+export DEBIAN_FRONTEND=noninteractive
+apt-get update
+# build-essential: the native shm ring (apex_tpu/native/shm_ring.cpp)
+# compiles on demand at first import
+apt-get install -y python3-venv python3-dev build-essential git tmux htop
+
+python3 -m venv "$ENV_DIR"
+"$ENV_DIR/bin/pip" install --upgrade pip
+
+# Core numerics are PINNED — these decide numerical behavior and the
+# learner/actor wire compatibility; env/comms extras float with floors
+# (they only wrap IO).  Versions match the tested image.
+if [ "$ACCEL" = "tpu" ]; then
+  "$ENV_DIR/bin/pip" install "jax[tpu]==0.9.0" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+else
+  "$ENV_DIR/bin/pip" install "jax==0.9.0"
+fi
+"$ENV_DIR/bin/pip" install \
+  "flax==0.12.3" "optax==0.2.6" "numpy==2.0.2" "pyzmq==27.1.0" \
+  "orbax-checkpoint" "chex" "einops" "msgpack" "tensorboardX" \
+  "gymnasium>=1.0" "ale-py" "opencv-python-headless"
+
+touch "$MARKER"
+echo "provision: $ACCEL env baked at $ENV_DIR"

@@ -45,6 +45,12 @@ variable "envs_per_actor" {
   description = "Env slots per actor process behind one batched policy call; raise to multiply fleet frames/s without more processes (ladder spans n_actors * envs_per_actor)"
 }
 
+variable "fleet_image" {
+  type        = string
+  default     = "ubuntu-os-cloud/ubuntu-2204-lts"
+  description = "Boot image for the CPU fleet (actors + evaluator). Point at the packer-baked family (deploy/packer: projects/<project>/global/images/family/apex-tpu-cpu) so nodes boot with /opt/apex-env pre-provisioned; the default stock Ubuntu provisions on first boot instead."
+}
+
 variable "actor_machine_type" {
   type    = string
   default = "n2-standard-8"

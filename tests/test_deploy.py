@@ -93,6 +93,85 @@ def test_firewall_ports_match_comms_config():
         "replay-server ports resurrected — that server is dissolved"
 
 
+def test_provisioning_is_pinned_and_idempotent():
+    """The Packer-analogue (VERDICT r4 item 7; reference:
+    origin_repo/deploy/packer/ape_x_cpu.sh): one parametrized provision
+    script bakes a PINNED env at /opt/apex-env, short-circuits on its
+    marker so baked images and first-boot paths share it, and covers both
+    accelerator flavors."""
+    text = (DEPLOY / "provision.sh").read_text()
+    assert re.search(r'"jax\[tpu\]==[\d.]+"', text), "jax[tpu] not pinned"
+    assert re.search(r'"jax==[\d.]+"', text), "cpu jax not pinned"
+    for pkg in ("flax", "optax", "numpy", "pyzmq"):
+        assert re.search(rf'"{pkg}==[\d.]+"', text), f"{pkg} not pinned"
+    assert "python3 -m venv" in text
+    assert "exit 0" in text and "MARKER" in text, "no idempotence marker"
+    assert "build-essential" in text, "native shm ring needs a compiler"
+
+
+def test_role_scripts_use_baked_env():
+    """Every role bootstrap must run through the provisioned interpreter
+    (baked image or first-boot fallback) — an unpinned system python is
+    exactly the version skew the bake exists to kill."""
+    for name, flavor in (("actor.sh", "cpu"), ("evaluator.sh", "cpu"),
+                         ("learner.sh", "tpu")):
+        text = (DEPLOY / name).read_text()
+        assert f"provision.sh {flavor}" in text, \
+            f"{name}: no first-boot provisioning fallback"
+        assert f".provisioned-{flavor}" in text, \
+            f"{name}: fallback not gated on the idempotence marker"
+        assert "/opt/apex-env/bin/python" in text, \
+            f"{name}: role not launched from the baked env"
+        assert re.search(r"(?<!apex-env/bin/)pip install(?! -e \. --no-deps)",
+                         text) is None, \
+            f"{name}: ad-hoc pip install outside the baked env"
+
+
+def test_packer_template_structure():
+    """deploy/packer/apex_images.pkr.hcl: balanced HCL, the build block
+    consumes the declared source, and the file provisioner ships the
+    provision script that actually exists."""
+    pkr = DEPLOY / "packer" / "apex_images.pkr.hcl"
+    text = _strip_comments_and_strings(pkr.read_text())
+    assert text.count("{") == text.count("}"), "packer HCL brace count"
+    srcs = re.findall(r'source\s+"googlecompute"\s+"(\w+)"', text)
+    assert srcs, "no googlecompute source"
+    for s in srcs:
+        assert f"source.googlecompute.{s}" in text, f"source {s} unused"
+    m = re.search(r'source\s*=\s*"\$\{path\.root\}/([./\w]+)"',
+                  pkr.read_text())
+    assert m, "file provisioner missing"
+    assert (pkr.parent / m.group(1)).resolve().exists(), \
+        f"provisioner ships missing file {m.group(1)}"
+    assert "provision.sh cpu" in pkr.read_text()
+
+
+def test_fleet_image_variable_wired():
+    """The baked image is selectable per fleet node (fleet_image), and the
+    TPU VM — which cannot boot custom images — still provisions via its
+    startup script."""
+    main, declared, _ = _main_and_vars()
+    assert "fleet_image" in declared
+    assert main.count("image = var.fleet_image") == 2   # actors + evaluator
+
+
+def test_validate_binaries_if_available():
+    """Run the real validators when the binaries exist (they don't in this
+    image — the structural checks above are the CI fallback)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("packer"):
+        p = subprocess.run(["packer", "validate", "-syntax-only",
+                            str(DEPLOY / "packer")],
+                           capture_output=True, text=True)
+        assert p.returncode == 0, p.stderr
+    if shutil.which("terraform"):
+        p = subprocess.run(["terraform", f"-chdir={DEPLOY}", "validate"],
+                           capture_output=True, text=True)
+        assert p.returncode == 0, p.stderr
+
+
 def test_bootstrap_scripts_have_supervisor_loops():
     """Crashed remote roles must respawn (VERDICT r3 weak #6): the actor
     and evaluator bootstraps carry the rate-limited supervisor loop that
