@@ -48,7 +48,7 @@ fi
 "$ENV_DIR/bin/pip" install \
   "flax==0.12.3" "optax==0.2.6" "numpy==2.0.2" "pyzmq==27.1.0" \
   "orbax-checkpoint" "chex" "einops" "msgpack" "tensorboardX" \
-  "gymnasium>=1.0" "ale-py" "opencv-python-headless"
+  "tensorboard" "gymnasium>=1.0" "ale-py" "opencv-python-headless"
 
 touch "$MARKER"
 echo "provision: $ACCEL env baked at $ENV_DIR"
