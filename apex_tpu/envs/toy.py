@@ -159,9 +159,17 @@ class RallyEnv(gym.Env):
     MIN_VY = 0.5           # center hits stay live (no horizontal stalemates)
 
     def __init__(self, grid: int = 21, pixels: int = 84, points: int = 3,
-                 paddle_half: int = 1):
+                 paddle_half: int = 1, agent_half: int | None = None,
+                 opp_speed: float = 1.0):
+        # ``agent_half`` widens ONLY the agent's paddle (easier receiving
+        # without making the opponent harder to score past) and
+        # ``opp_speed`` caps the opponent's per-step tracking — the two
+        # difficulty knobs the Small certificate variant uses; the full
+        # variant keeps the symmetric speed-1 game
         self.grid, self.pixels, self.points = grid, pixels, points
         self.half = paddle_half
+        self.agent_half = self.half if agent_half is None else agent_half
+        self.opp_speed = opp_speed
         self.observation_space = gym.spaces.Box(0, 255, (pixels, pixels, 1),
                                                 np.uint8)
         self.action_space = gym.spaces.Discrete(3)
@@ -192,15 +200,17 @@ class RallyEnv(gym.Env):
         return float(np.clip(vy, -self.MAX_VY, self.MAX_VY))
 
     def step(self, action):
-        g, half = self.grid, self.half
+        g, half, ahalf = self.grid, self.half, self.agent_half
         # agent paddle
         self._agent_y = float(np.clip(
-            self._agent_y + (0, -1, 1)[int(action)], half, g - 1 - half))
-        # scripted opponent: track the ball at speed 1 at ALL times (a
-        # re-centering opponent loses to plain tracking — measured; this
-        # one only loses to deliberately generated steep angles)
+            self._agent_y + (0, -1, 1)[int(action)], ahalf, g - 1 - ahalf))
+        # scripted opponent: track the ball at ALL times (a re-centering
+        # opponent loses to plain tracking — measured; this one only
+        # loses to deliberately generated steep angles, or — at reduced
+        # opp_speed — to sustained accurate returns)
         self._opp_y = float(np.clip(
-            self._opp_y + np.clip(self._by - self._opp_y, -1.0, 1.0),
+            self._opp_y + np.clip(self._by - self._opp_y,
+                                  -self.opp_speed, self.opp_speed),
             half, g - 1 - half))
         # ball advance + wall reflection
         self._bx += self._vx
@@ -223,10 +233,10 @@ class RallyEnv(gym.Env):
                 self._played += 1
                 self._serve(toward_agent=False)
         elif self._bx >= g - 1:                 # agent's goal column
-            if abs(self._by - self._agent_y) <= half + 0.5:
+            if abs(self._by - self._agent_y) <= ahalf + 0.5:
                 self._bx, self._vx = float(g - 1), -1
                 self._vy = self._deflect(
-                    (self._by - self._agent_y) / (half + 0.5))
+                    (self._by - self._agent_y) / (ahalf + 0.5))
             else:
                 reward = -1.0
                 self._played += 1
@@ -245,7 +255,7 @@ class RallyEnv(gym.Env):
     def _render(self) -> np.ndarray:
         img = np.zeros((self.pixels, self.pixels, 1), np.uint8)
         self._block(img, self._opp_y, 0, self.half, 128)
-        self._block(img, self._agent_y, self.grid - 1, self.half, 128)
+        self._block(img, self._agent_y, self.grid - 1, self.agent_half, 128)
         bx = int(np.clip(round(self._bx), 0, self.grid - 1))
         self._block(img, self._by, bx, 0, 255)
         return img

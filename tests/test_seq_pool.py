@@ -251,6 +251,33 @@ def test_r2d2_pixel_pooled_driver_mechanics():
 
 
 @pytest.mark.slow
+def test_r2d2_pooled_checkpoint_roundtrip(tmp_path):
+    """Full-bundle checkpoints cover the pooled state too: params, ring,
+    id tables, trees, cursors and the transition counter all restore
+    bit-exactly, and the restored trainer keeps training."""
+    from apex_tpu.training.r2d2 import R2D2Trainer
+
+    cfg = small_test_config(capacity=256, batch_size=8,
+                            env_id="ApexCatchSmall-v0")
+    cfg = cfg.replace(replay=dataclasses.replace(cfg.replay,
+                                                 frame_pool=True))
+    t = R2D2Trainer(cfg, checkpoint_dir=str(tmp_path))
+    t.train(total_frames=500, log_every=10 ** 9, warmup_sequences=8)
+    t.save_checkpoint()
+
+    t2 = R2D2Trainer(cfg, checkpoint_dir=str(tmp_path))
+    t2.restore()
+    assert t2.pooled
+    assert t2.steps_rate.total == t.steps_rate.total
+    assert t2.transitions == t.transitions
+    for a, b in zip(jax.tree.leaves(t.replay_state),
+                    jax.tree.leaves(t2.replay_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t2.train(total_frames=120, log_every=10 ** 9, warmup_sequences=8)
+    assert t2.frames_rate.total == t.frames_rate.total + 120
+
+
+@pytest.mark.slow
 def test_r2d2_apex_pooled_concurrent_mechanics():
     """Concurrent pooled R2D2: worker processes build POOLED sequence
     messages (the shared frame-pool predicate picks the layout on both
