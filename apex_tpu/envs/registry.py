@@ -100,17 +100,17 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
             else:
                 env = toy.CatchEnv()
         else:
-            # Small: wide agent paddle + 0.6-speed opponent — the two
+            # Small: wide agent paddle + 0.45-speed opponent — the two
             # levers calibration showed matter for a CI-budget DQN
             # (reward density from reliable catches; a grid-10 big-ball
-            # variant measured WORSE).  Ladder: random -0.93 / tracking
-            # +1.67 / edge +2.0.  The full variant keeps the symmetric
+            # variant measured WORSE).  Ladder: random -0.68 / tracking
+            # +1.65 / edge +2.0.  The full variant keeps the symmetric
             # speed-1 duel (ladder measured on the same 14-cell 2-point
             # court WITHOUT the Small handicaps: random -1.45 / tracking
             # +0.57 / edge +2.0 — the 21-cell 3-point full env scales
             # these, it has not been separately calibrated).
             env = (toy.RallyEnv(grid=14, pixels=42, points=2,
-                                agent_half=2, opp_speed=0.6)
+                                agent_half=2, opp_speed=0.45)
                    if "Small" in env_id else toy.RallyEnv())
         # ONE copy of the pixel wrapper tail for every toy pixel env
         if max_episode_steps is not None:
