@@ -1,0 +1,25 @@
+"""apexlint — JAX/TPU-aware static analysis for the apex-tpu tree.
+
+An AST-based rule engine for the hazard classes no generic linter sees:
+un-donated jit step buffers (J001), host syncs inside compiled code (J002),
+Python control flow on traced values (J003), PRNG key reuse (J004),
+jit-in-loop retracing (J005), fork-after-thread deadlocks (C001), leaked
+ZMQ sockets (C002), and shared-memory segments that violate the
+creator-owns-unlink contract (C003/C004).
+
+Run it: ``python -m apex_tpu.analysis apex_tpu/`` (or ``scripts/lint.sh``).
+Suppress a deliberate pattern inline::
+
+    q = float(np.max(scores))  # apexlint: disable=J002 -- host priority path
+
+Accept pre-existing findings wholesale with the checked-in baseline
+(``.apexlint-baseline.json``; regenerate via ``--write-baseline``).  The
+package is pure stdlib — importing it never touches JAX or the TPU.
+"""
+
+from apex_tpu.analysis.core import (Baseline, Finding, ModuleContext, Rule,
+                                    all_rules, analyze_paths, analyze_source,
+                                    register)
+
+__all__ = ["Baseline", "Finding", "ModuleContext", "Rule", "all_rules",
+           "analyze_paths", "analyze_source", "register"]

@@ -1,0 +1,12 @@
+"""``python -m apex_tpu.analysis`` entry point."""
+
+import sys
+
+from apex_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:       # `... | head` closed stdout: not an error
+        sys.stderr.close()
+        sys.exit(0)

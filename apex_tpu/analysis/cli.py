@@ -1,0 +1,205 @@
+"""apexlint CLI: ``python -m apex_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (every finding suppressed or baselined), 1 findings
+(or, under ``--strict``, stale baseline entries), 2 usage errors.
+
+Configuration rides in ``[tool.apexlint]`` in pyproject.toml (paths,
+exclude, baseline, disable); Python 3.10 has no tomllib, so a minimal
+single-section reader handles the flat keys apexlint uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast as _ast
+import json
+import os
+import re
+import sys
+
+from apex_tpu.analysis.core import (Baseline, all_rules, analyze_paths)
+
+DEFAULT_BASELINE = ".apexlint-baseline.json"
+
+
+def find_project_root(start: str | None = None) -> str | None:
+    """Nearest ancestor of ``start`` (default cwd) holding pyproject.toml."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+_SECTION_RE = re.compile(r"^\s*\[(?P<name>[^\]]+)\]\s*$")
+_KEY_RE = re.compile(r"^\s*(?P<key>[A-Za-z0-9_-]+)\s*=\s*(?P<val>.+)$")
+
+
+def load_config(root: str | None) -> dict:
+    """Flat ``[tool.apexlint]`` keys from pyproject.toml.  Values are
+    strings or arrays of strings (whose literal syntax TOML shares with
+    Python); anything fancier is ignored."""
+    cfg: dict = {}
+    if root is None:
+        return cfg
+    path = os.path.join(root, "pyproject.toml")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return cfg
+    in_section = False
+    buf = ""
+    key = None
+    for line in lines:
+        m = _SECTION_RE.match(line)
+        if m:
+            in_section = m.group("name").strip() == "tool.apexlint"
+            buf, key = "", None
+            continue
+        if not in_section:
+            continue
+        if key is None:
+            m = _KEY_RE.match(line)
+            if not m:
+                continue
+            key, buf = m.group("key"), m.group("val")
+        else:
+            buf += " " + line.strip()
+        if buf.count("[") > buf.count("]"):
+            continue                      # multiline array: keep folding
+        try:
+            cfg[key] = _ast.literal_eval(buf.split("#")[0].strip())
+        except (ValueError, SyntaxError):
+            pass
+        key, buf = None, ""
+    return cfg
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="apexlint: JAX/TPU-aware static analysis for apex-tpu")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: [tool.apexlint] "
+                        "paths, else apex_tpu/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} at "
+                        f"the project root, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current unsuppressed findings into the "
+                        "baseline and exit 0")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries (fixed code "
+                        "must leave the ledger)")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+
+    if args.list_rules:
+        for rid, rule in rules.items():
+            print(f"{rid}  {rule.name}\n    {rule.description}")
+        return 0
+
+    root = find_project_root()
+    cfg = load_config(root)
+
+    disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
+    disabled |= set(cfg.get("disable", []))
+    unknown = disabled - set(rules)
+    if unknown:
+        print(f"apexlint: unknown rule id(s): {', '.join(sorted(unknown))}",
+              file=sys.stderr)
+        return 2
+    rules = {rid: r for rid, r in rules.items() if rid not in disabled}
+
+    paths = args.paths
+    if not paths:
+        # config paths are project-root-relative, not cwd-relative
+        base = root or os.getcwd()
+        paths = [os.path.join(base, p)
+                 for p in (cfg.get("paths") or ["apex_tpu"])]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"apexlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    exclude = tuple(cfg.get("exclude", ()))
+
+    baseline_path = args.baseline
+    if baseline_path is None and cfg.get("baseline"):
+        # config baseline is project-root-relative, like config paths
+        baseline_path = os.path.join(root or os.getcwd(),
+                                     cfg["baseline"])
+    if baseline_path is None and root is not None:
+        cand = os.path.join(root, DEFAULT_BASELINE)
+        if os.path.exists(cand) or args.write_baseline:
+            baseline_path = cand
+    if args.no_baseline:
+        baseline_path = None
+
+    findings, suppressed = analyze_paths(paths, exclude=exclude,
+                                         rules=rules, root=root)
+
+    if args.write_baseline:
+        if baseline_path is None:
+            baseline_path = DEFAULT_BASELINE
+        Baseline.from_findings(findings).write(baseline_path)
+        print(f"apexlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path)}")
+        return 0
+
+    baseline = Baseline()
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"apexlint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, baselined, stale = baseline.partition(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_baseline": stale,
+            "summary": {"new": len(new), "baselined": len(baselined),
+                        "suppressed": len(suppressed),
+                        "stale_baseline": len(stale)},
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        tail = (f"{len(new)} finding(s) "
+                f"({len(baselined)} baselined, "
+                f"{len(suppressed)} suppressed inline)")
+        if stale:
+            tail += f", {len(stale)} stale baseline entr" \
+                    f"{'y' if len(stale) == 1 else 'ies'}"
+            if args.strict:
+                for e in stale:
+                    print(f"stale baseline entry: {e['rule']} {e['path']} "
+                          f"{e['code']!r} x{e['count']}")
+        print(f"apexlint: {tail}")
+
+    if new:
+        return 1
+    if args.strict and stale:
+        return 1
+    return 0

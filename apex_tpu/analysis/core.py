@@ -1,0 +1,415 @@
+"""apexlint core: module model, rule registry, suppressions, baseline.
+
+Pure stdlib (``ast`` + ``tokenize``): the analyzer imports nothing heavy, so
+it runs before any JAX/TPU initialization and in CI images with no
+accelerator deps.  Rules operate on a :class:`ModuleContext` — one parsed
+file plus the derived facts every rule needs (parent links, which functions
+are jitted scope, suppression comments).
+
+Jitted-scope detection is deliberately heuristic (static analysis cannot see
+through arbitrary higher-order wrapping); the per-rule fixture tests in
+``tests/test_analysis.py`` are the behavioral contract.  A function counts
+as jitted scope when:
+
+* it is decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+* its name is passed to a ``jax.jit(...)`` call anywhere in the module
+  (``jax.jit(self.train_step, ...)`` marks ``train_step``);
+* it is returned by a ``make_*_fn`` factory (the repo's policy-fn
+  convention — call sites jit the factory's result in other modules);
+* it is (transitively) called by name from another jitted function in the
+  same module (``train_step -> update_from_batch``).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+# -- findings ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``code`` (the stripped source line) is the stable
+    part of the baseline fingerprint — line numbers drift, code lines move
+    with the finding."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    code: str = ""
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.rule, self.path.replace(os.sep, "/"), self.code)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "code": self.code}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule} {self.message}")
+
+
+# -- rule registry ----------------------------------------------------------
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "ModuleContext") -> list[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # rule modules register on import; import here to avoid a cycle
+    from apex_tpu.analysis import rules_concurrency, rules_jax  # noqa: F401
+    return dict(sorted(_REGISTRY.items()))
+
+
+# -- jit detection helpers --------------------------------------------------
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``(functools.)partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return (node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+    if isinstance(node, ast.Call):
+        f = node.func
+        is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
+                      or (isinstance(f, ast.Attribute)
+                          and f.attr == "partial"))
+        return bool(is_partial and node.args and is_jit_expr(node.args[0]))
+    return False
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Bare name of the callee: ``g(...)`` -> g, ``x.g(...)`` -> g."""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+_MAKE_FN_RE = re.compile(r"^make_\w+_fn$")
+
+
+class ModuleContext:
+    """One parsed module plus derived facts shared by all rules."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.functions = [n for n in ast.walk(self.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+        self.jitted = self._collect_jitted()
+        self._inline_supp, self._standalone_supp = \
+            _collect_suppressions(source)
+
+    # -- navigation --------------------------------------------------------
+
+    def ancestors(self, node: ast.AST):
+        n = self.parents.get(node)
+        while n is not None:
+            yield n
+            n = self.parents.get(n)
+
+    def enclosing_function(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+    def in_jitted_scope(self, node: ast.AST):
+        """Innermost enclosing jitted FunctionDef (nested defs inside a
+        jitted function are jitted scope too), or None."""
+        n = node
+        while n is not None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if n in self.jitted:
+                    return n
+            n = self.parents.get(n)
+        return None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: Rule, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule.id, path=self.path, line=line, col=col,
+                       message=message, code=self.line_text(line))
+
+    # -- jitted-scope collection ------------------------------------------
+
+    def _collect_jitted(self) -> set:
+        jitted: set = set()
+        seeds: set[str] = set()
+        for fn in self.functions:
+            if any(is_jit_expr(d) for d in fn.decorator_list):
+                jitted.add(fn)
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Call) and is_jit_expr(node.func)
+                    and node.args):
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Name):
+                    seeds.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    seeds.add(tgt.attr)
+        # make_*_fn factories: the returned closures are jitted at call
+        # sites in other modules
+        for fn in self.functions:
+            if not _MAKE_FN_RE.match(fn.name):
+                continue
+            returned = {r.value.id for r in ast.walk(fn)
+                        if isinstance(r, ast.Return)
+                        and isinstance(r.value, ast.Name)}
+            for sub in self.functions:
+                if sub.name in returned and self._encloses(fn, sub):
+                    jitted.add(sub)
+        for fn in self.functions:
+            if fn.name in seeds:
+                jitted.add(fn)
+        # transitive closure over the same-module call graph (by bare name)
+        by_name: dict[str, list] = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(jitted):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for cand in by_name.get(call_name(node) or "", []):
+                        if cand not in jitted:
+                            jitted.add(cand)
+                            changed = True
+        return jitted
+
+    def _encloses(self, outer: ast.AST, inner: ast.AST) -> bool:
+        return outer is not inner and any(a is outer
+                                          for a in self.ancestors(inner))
+
+    # -- suppressions ------------------------------------------------------
+
+    def is_suppressed(self, f: Finding) -> bool:
+        rules = set(self._inline_supp.get(f.line, ()))
+        # standalone `# apexlint: disable=...` comment lines apply to the
+        # next code line; consecutive comment lines stack
+        line = f.line - 1
+        while line in self._standalone_supp:
+            rules |= self._standalone_supp[line]
+            line -= 1
+        return "all" in rules or f.rule in rules
+
+
+_DISABLE_RE = re.compile(r"apexlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+def _collect_suppressions(source: str):
+    """Line -> suppressed-rule-ids maps from ``# apexlint: disable=...``
+    comments.  Inline comments cover their own line; comment-only lines
+    cover the next code line.  Text after ``--`` is a justification."""
+    inline: dict[int, set[str]] = {}
+    standalone: dict[int, set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return inline, standalone
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string.split("--")[0])
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if tok.line[:tok.start[1]].strip():
+            inline.setdefault(tok.start[0], set()).update(rules)
+        else:
+            standalone.setdefault(tok.start[0], set()).update(rules)
+    return inline, standalone
+
+
+# -- analysis entry points --------------------------------------------------
+
+#: pseudo-rule id for unparseable files
+PARSE_ERROR = "E001"
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", "_build", ".eggs", "build", "dist"}
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: dict[str, Rule] | None = None,
+                   respect_suppressions: bool = True):
+    """Analyze one module.  Returns ``(findings, suppressed)`` — both lists
+    of :class:`Finding`, split by inline ``disable`` comments."""
+    rules = all_rules() if rules is None else rules
+    try:
+        ctx = ModuleContext(path, source)
+    except (SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return [Finding(rule=PARSE_ERROR, path=path, line=line, col=0,
+                        message=f"file does not parse: {e.msg}"
+                        if isinstance(e, SyntaxError) else str(e))], []
+    findings: list[Finding] = []
+    for rule in rules.values():
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    if not respect_suppressions:
+        return findings, []
+    kept = [f for f in findings if not ctx.is_suppressed(f)]
+    suppressed = [f for f in findings if ctx.is_suppressed(f)]
+    return kept, suppressed
+
+
+def iter_python_files(paths, exclude=()):
+    """Yield .py files under ``paths`` (files or directories), skipping
+    build/cache dirs and any path containing an ``exclude`` substring."""
+    exclude = tuple(exclude)
+
+    def excluded(p: str) -> bool:
+        norm = p.replace(os.sep, "/")
+        return any(e in norm for e in exclude)
+
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py") and not excluded(path):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _EXCLUDE_DIRS
+                                 and not excluded(os.path.join(dirpath, d)))
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                if fn.endswith(".py") and not excluded(full):
+                    yield full
+
+
+def analyze_paths(paths, exclude=(), rules: dict[str, Rule] | None = None,
+                  root: str | None = None):
+    """Analyze every .py file under ``paths``.  Finding paths are made
+    relative to ``root`` (default: cwd) so baselines are machine-portable.
+    Returns ``(findings, suppressed)``."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for file in iter_python_files(paths, exclude):
+        rel = os.path.relpath(os.path.abspath(file), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(file, "r", encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding(rule=PARSE_ERROR, path=rel, line=1,
+                                    col=0, message=f"unreadable: {e}"))
+            continue
+        got, supp = analyze_source(source, path=rel, rules=rules)
+        findings.extend(got)
+        suppressed.extend(supp)
+    return findings, suppressed
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+class Baseline:
+    """Checked-in ledger of accepted pre-existing findings.
+
+    Fingerprint = (rule, path, stripped code line) with a count — stable
+    under unrelated edits (line numbers move, the flagged line's text
+    doesn't).  ``--write-baseline`` regenerates it; strict mode fails on
+    STALE entries (fixed code must leave the ledger) so the baseline only
+    ever shrinks."""
+
+    def __init__(self, counts: dict[tuple[str, str, str], int] | None = None):
+        self.counts = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        counts: dict[tuple[str, str, str], int] = {}
+        for e in data.get("findings", []):
+            fp = (e["rule"], e["path"], e.get("code", ""))
+            counts[fp] = counts.get(fp, 0) + int(e.get("count", 1))
+        return cls(counts)
+
+    @classmethod
+    def from_findings(cls, findings) -> "Baseline":
+        b = cls()
+        for f in findings:
+            fp = f.fingerprint()
+            b.counts[fp] = b.counts.get(fp, 0) + 1
+        return b
+
+    def write(self, path: str) -> None:
+        entries = [{"rule": r, "path": p, "code": c, "count": n}
+                   for (r, p, c), n in sorted(self.counts.items())]
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"comment": "apexlint baseline — accepted "
+                                  "pre-existing findings; regenerate with "
+                                  "--write-baseline, never hand-grow",
+                       "version": 1, "findings": entries}, fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+
+    def partition(self, findings):
+        """Split ``findings`` into (new, baselined); returns the stale
+        leftover entries third."""
+        remaining = dict(self.counts)
+        new, matched = [], []
+        for f in findings:
+            fp = f.fingerprint()
+            if remaining.get(fp, 0) > 0:
+                remaining[fp] -= 1
+                matched.append(f)
+            else:
+                new.append(f)
+        stale = [{"rule": r, "path": p, "code": c, "count": n}
+                 for (r, p, c), n in sorted(remaining.items()) if n > 0]
+        return new, matched, stale

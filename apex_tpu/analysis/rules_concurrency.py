@@ -1,0 +1,415 @@
+"""C-series rules: process/thread/socket/shared-memory lifecycle hazards.
+
+These encode the runtime's hard-won discipline: spawn-context worker pools
+around live threads (``actors/pool.py`` module docstring), close-on-every-
+exit-path ZMQ sockets (``runtime/transport.py``), and the creator-owns-
+unlink shared-memory contract (``native/ring.py``).  Contracts are the
+fixture pairs in ``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.core import (Finding, ModuleContext, Rule,
+                                    register)
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _callee_basename(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _kwarg(call: ast.Call, name: str):
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _is_true(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` expression."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _stmt_order(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+# -- C001 -------------------------------------------------------------------
+
+
+@register
+class ForkAfterThread(Rule):
+    id = "C001"
+    name = "fork-after-thread"
+    description = ("multiprocessing.Process started after a threading."
+                   "Thread is live, with no spawn/forkserver start method "
+                   "in sight: fork copies the lock state of invisible "
+                   "threads and deadlocks the child")
+
+    _SAFE_METHODS = ("spawn", "forkserver")
+
+    def _file_pins_safe_start(self, ctx: ModuleContext) -> bool:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_basename(node) in ("get_context",
+                                          "set_start_method"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value in self._SAFE_METHODS:
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if self._file_pins_safe_start(ctx):
+            return []
+        out = []
+        for fn in ctx.functions:
+            out.extend(self._scan_scope(ctx, fn.body, owner=fn))
+        out.extend(self._scan_scope(ctx, ctx.tree.body, owner=None))
+        return out
+
+    def _scan_scope(self, ctx: ModuleContext, body,
+                    owner=None) -> list[Finding]:
+        """Linear scan of one scope: var kinds from Thread(...)/Process(...)
+        constructions, then .start() events in source order.  Only nodes
+        whose enclosing function is exactly ``owner`` belong to this scope
+        — a thread started in one function and a process in another are
+        different (runtime-unordered) scopes."""
+        kinds: dict[str, str] = {}      # var -> "thread" | "process"
+        events: list[tuple[tuple, str, ast.AST]] = []
+
+        def kind_of(call: ast.Call) -> str | None:
+            base = _callee_basename(call)
+            if base == "Thread":
+                return "thread"
+            if base == "Process":
+                return "process"
+            return None
+
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if ctx.enclosing_function(node) is not owner:
+                    continue
+                k = kind_of(node)
+                if k is not None:
+                    parent = ctx.parents.get(node)
+                    if isinstance(parent, ast.Assign):
+                        for t in parent.targets:
+                            if isinstance(t, ast.Name):
+                                kinds[t.id] = k
+                            a = _self_attr(t)
+                            if a:
+                                kinds["self." + a] = k
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute) and f.attr == "start"):
+                    continue
+                recv = f.value
+                recv_kind = None
+                if isinstance(recv, ast.Call):        # Thread(...).start()
+                    recv_kind = kind_of(recv)
+                elif isinstance(recv, ast.Name):
+                    recv_kind = kinds.get(recv.id)
+                else:
+                    a = _self_attr(recv)
+                    if a:
+                        recv_kind = kinds.get("self." + a)
+                if recv_kind:
+                    events.append((_stmt_order(node), recv_kind, node))
+
+        events.sort(key=lambda e: e[0])
+        out = []
+        thread_live = False
+        for _, kind, node in events:
+            if kind == "thread":
+                thread_live = True
+            elif kind == "process" and thread_live:
+                out.append(ctx.finding(
+                    self, node,
+                    "Process.start() after a Thread is live in this scope "
+                    "— fork inherits the thread's lock state and can "
+                    "deadlock; use mp.get_context('spawn') (or start "
+                    "processes first)"))
+        return out
+
+
+# -- C002 -------------------------------------------------------------------
+
+
+class _LifecycleRule(Rule):
+    """Shared machinery for resource-lifecycle rules (C002/C003): a
+    resource constructed in a scope must be released in that scope (local
+    var) or by a teardown method of the owning class (``self.x``); values
+    that escape (returned / stored elsewhere / passed on) are the
+    receiver's problem."""
+
+    #: attribute calls that count as releasing the resource
+    release_attrs: frozenset = frozenset()
+
+    def _is_resource_call(self, node: ast.Call, ctx: ModuleContext) -> bool:
+        raise NotImplementedError
+
+    def _message(self, where: str) -> str:
+        raise NotImplementedError
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for fn in ctx.functions:
+            out.extend(self._check_function(ctx, fn))
+        return out
+
+    def _check_function(self, ctx: ModuleContext, fn) -> list[Finding]:
+        out = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and self._is_resource_call(node, ctx)):
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue                       # nested def handles its own
+            parent = ctx.parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue                       # context manager releases
+            if not isinstance(parent, ast.Assign):
+                # constructed and passed/returned inline: escapes
+                continue
+            local, attr = None, None
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    local = t.id
+                attr = attr or _self_attr(t)
+            if attr is not None:
+                if not self._class_releases(ctx, node, attr):
+                    out.append(ctx.finding(
+                        self, node, self._message(f"self.{attr}")))
+            elif local is not None:
+                if not self._function_releases(fn, local):
+                    out.append(ctx.finding(
+                        self, node, self._message(local)))
+        return out
+
+    def _function_releases(self, fn, var: str) -> bool:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.release_attrs
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == var):
+                return True
+            # escapes: returned, yielded, or handed to another owner
+            if (isinstance(node, (ast.Return, ast.Yield))
+                    and node.value is not None
+                    and any(isinstance(n, ast.Name) and n.id == var
+                            for n in ast.walk(node.value))):
+                return True
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == var:
+                        return True
+            if isinstance(node, ast.Assign) and any(
+                    not isinstance(t, ast.Name)
+                    for t in node.targets) and any(
+                    isinstance(n, ast.Name) and n.id == var
+                    for n in ast.walk(node.value)):
+                return True                    # stored into a structure
+        return False
+
+    def _class_releases(self, ctx: ModuleContext, node: ast.AST,
+                        attr: str) -> bool:
+        cls = ctx.enclosing_class(node)
+        if cls is None:
+            return True                        # module-level self? bail out
+        for n in ast.walk(cls):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in self.release_attrs):
+                recv = n.func.value
+                if _self_attr(recv) == attr:
+                    return True
+                # released through iteration (`for q in [self.x, ...]:`)
+                if isinstance(recv, ast.Name) and \
+                        self._released_via_alias(cls, attr, recv.id):
+                    return True
+        return False
+
+    @staticmethod
+    def _released_via_alias(cls, attr: str, alias: str) -> bool:
+        for n in ast.walk(cls):
+            if isinstance(n, (ast.For, ast.comprehension)):
+                tgt = n.target
+                if isinstance(tgt, ast.Name) and tgt.id == alias:
+                    for sub in ast.walk(n.iter):
+                        if _self_attr(sub) == attr:
+                            return True
+        return False
+
+
+@register
+class ZmqSocketLeak(_LifecycleRule):
+    id = "C002"
+    name = "zmq-socket-leak"
+    description = ("zmq socket/context created without close()/term() on "
+                   "an exit path: lingering sockets hold ports and peer "
+                   "connections past role death (transport.py closes every "
+                   "socket it binds, including on the error path)")
+
+    release_attrs = frozenset({"close", "term", "destroy", "stop",
+                               "cleanup"})
+
+    def _is_resource_call(self, node: ast.Call, ctx: ModuleContext) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "socket":
+            # receiver looks like a zmq context, or the socket type arg is
+            # rooted at the zmq module (ctx.socket(zmq.ROUTER))
+            for arg in node.args:
+                root = arg
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "zmq":
+                    return True
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in ("zmq", "ctx",
+                                                          "context"):
+                return True
+            if isinstance(recv, ast.Call):
+                base = _callee_basename(recv) or ""
+                return "ctx" in base.lower() or "context" in base.lower() \
+                    or base == "instance"
+            return False
+        # zmq.Context() construction (NOT .instance(): shared singleton)
+        if isinstance(f, ast.Attribute) and f.attr == "Context" \
+                and isinstance(f.value, ast.Name) and f.value.id == "zmq":
+            return True
+        return False
+
+    def _message(self, where: str) -> str:
+        return (f"zmq socket bound to {where} has no close()/term() on any "
+                f"exit path — close it in a finally/cleanup or the port "
+                f"and peer connections leak")
+
+
+# -- C003 -------------------------------------------------------------------
+
+
+def _is_shm_ctor(node: ast.Call) -> bool:
+    base = _callee_basename(node) or ""
+    return ("SharedMemory" in base or "ShmRing" in base
+            or base.startswith("shm_") or base.endswith("_shm"))
+
+
+@register
+class ShmLifecycle(_LifecycleRule):
+    id = "C003"
+    name = "shm-lifecycle"
+    description = ("shared-memory segment created (create=True) without "
+                   "close()/unlink() in its owning scope: the segment "
+                   "outlives the process in /dev/shm (ring.py contract: "
+                   "the creator owns the segment and unlinks it on close)")
+
+    release_attrs = frozenset({"close", "unlink", "cleanup", "stop"})
+
+    def _is_resource_call(self, node: ast.Call, ctx: ModuleContext) -> bool:
+        return _is_shm_ctor(node) and _is_true(_kwarg(node, "create"))
+
+    def _message(self, where: str) -> str:
+        return (f"shm segment created into {where} with create=True but "
+                f"never closed/unlinked in its owning scope — the segment "
+                f"leaks in /dev/shm on every run")
+
+
+@register
+class ShmForeignUnlink(Rule):
+    id = "C004"
+    name = "shm-foreign-unlink"
+    description = ("unlink() on a shared-memory segment this scope only "
+                   "OPENED (create=False): unlinking from a non-creator "
+                   "yanks the segment out from under the owner and every "
+                   "sibling (ring.py contract: creator owns unlink)")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        # class-level map: attr -> created-here?
+        created_attrs: dict[str, dict[str, bool]] = {}
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            attrs: dict[str, bool] = {}
+            for n in ast.walk(cls):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, ast.Call) and \
+                        _is_shm_ctor(n.value):
+                    for t in n.targets:
+                        a = _self_attr(t)
+                        if a:
+                            attrs[a] = attrs.get(a, False) or \
+                                _is_true(_kwarg(n.value, "create"))
+            created_attrs[cls.name] = attrs
+
+        for fn in ctx.functions:
+            local_shm: dict[str, bool] = {}     # var -> created?
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_shm_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_shm[t.id] = _is_true(
+                                _kwarg(node.value, "create"))
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "unlink"
+                        and not node.args):
+                    continue
+                recv = node.func.value
+                if self._owner_guarded(ctx, node):
+                    continue
+                if isinstance(recv, ast.Name):
+                    if recv.id in local_shm and not local_shm[recv.id]:
+                        out.append(ctx.finding(
+                            self, node,
+                            f"'{recv.id}.unlink()' but this scope opened "
+                            f"the segment with create=False — only the "
+                            f"creator unlinks (ring.py contract)"))
+                else:
+                    a = _self_attr(recv)
+                    cls = ctx.enclosing_class(node)
+                    if a and cls is not None:
+                        attrs = created_attrs.get(cls.name, {})
+                        if a in attrs and not attrs[a]:
+                            out.append(ctx.finding(
+                                self, node,
+                                f"'self.{a}.unlink()' but this class only "
+                                f"opens the segment (create=False) — only "
+                                f"the creator unlinks (ring.py contract)"))
+        return out
+
+    @staticmethod
+    def _owner_guarded(ctx: ModuleContext, node: ast.AST) -> bool:
+        """unlink under ``if self._owner:``-style guards is the documented
+        creator path even when the create= flag is runtime-determined."""
+        for a in ctx.ancestors(node):
+            if isinstance(a, ast.If):
+                src_names = {n.attr if isinstance(n, ast.Attribute) else
+                             getattr(n, "id", "")
+                             for n in ast.walk(a.test)}
+                if any("owner" in s or "creator" in s or "created" in s
+                       for s in src_names if s):
+                    return True
+        return False

@@ -1,0 +1,488 @@
+"""J-series rules: JAX/TPU pipeline hazards.
+
+These encode the throughput discipline the training stack already follows
+by hand (``training/aql.py:153-163``, ``training/r2d2.py:265-275``): donated
+step buffers, no host round-trips inside compiled code, split-don't-reuse
+PRNG keys, trace-once jit.  Each rule's behavioral contract is its fixture
+pair in ``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from apex_tpu.analysis.core import (Finding, ModuleContext, Rule, call_name,
+                                    is_jit_expr, register)
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _is_step_name(name: str) -> bool:
+    """Names that take large donated state as leading args: the train /
+    fused / ingest step family.  Policy fns (params reused across calls)
+    deliberately don't match."""
+    n = name.lower().lstrip("_")
+    if "ingest" in n:
+        return True
+    return "step" in n and any(t in n for t in
+                               ("train", "fused", "update", "multi"))
+
+
+def _has_donation(call: ast.Call) -> bool:
+    return any(k.arg in ("donate_argnums", "donate_argnames")
+               for k in call.keywords)
+
+
+def _attr_root(node: ast.AST) -> str | None:
+    """Leftmost name of an attribute chain: ``np.asarray`` -> np."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_JNP_ALIASES = {"jnp", "jax"}
+
+
+def _loops_between(ctx: ModuleContext, node: ast.AST, stop: ast.AST | None):
+    """Enclosing For/While nodes of ``node`` up to (exclusive) ``stop`` or
+    the enclosing function boundary.  A For whose ``iter``/``target`` holds
+    the node doesn't count — that expression evaluates once, not per
+    iteration (a While ``test`` does re-evaluate, so it counts)."""
+    out = []
+    child = node
+    for a in ctx.ancestors(node):
+        if a is stop:
+            break
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        if isinstance(a, (ast.For, ast.AsyncFor)):
+            if child is not a.iter and child is not a.target:
+                out.append(a)
+        elif isinstance(a, ast.While):
+            out.append(a)
+        child = a
+    return out
+
+
+# -- J001 -------------------------------------------------------------------
+
+
+@register
+class JitMissingDonation(Rule):
+    id = "J001"
+    name = "jit-missing-donation"
+    description = ("jit-wrapped train/ingest step without donate_argnums: "
+                   "the old state buffers stay live across the update and "
+                   "double learner HBM")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)):
+                continue
+            if not node.args or _has_donation(node):
+                continue
+            tgt = node.args[0]
+            if isinstance(tgt, ast.Name):
+                name = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                name = tgt.attr
+            else:
+                continue                  # jit(factory(...)): not a step ref
+            if is_jit_expr(tgt):          # the partial(jax.jit, ...) form
+                continue
+            if _is_step_name(name):
+                out.append(ctx.finding(
+                    self, node,
+                    f"jax.jit({name}) without donate_argnums — donate the "
+                    f"state args or the update keeps both copies in HBM"))
+        # decorator form: @jax.jit / @partial(jax.jit, ...) on a step def
+        for fn in ctx.functions:
+            if not _is_step_name(fn.name):
+                continue
+            for dec in fn.decorator_list:
+                if not is_jit_expr(dec):
+                    continue
+                if isinstance(dec, ast.Call) and _has_donation(dec):
+                    continue
+                out.append(ctx.finding(
+                    self, dec,
+                    f"@jit on step '{fn.name}' without donate_argnums — "
+                    f"donate the state args or the update keeps both "
+                    f"copies in HBM"))
+        return out
+
+
+# -- J002 -------------------------------------------------------------------
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "J002"
+    name = "host-sync-in-jit"
+    description = ("float()/int()/bool()/.item()/np.asarray() on a traced "
+                   "value inside a jitted function: forces a host-device "
+                   "sync per call and serializes the pipeline")
+
+    _BUILTINS = {"float", "int", "bool"}
+    _METHODS = {"item", "tolist"}
+    _NUMPY_FUNCS = {"asarray", "array"}
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = ctx.in_jitted_scope(node)
+            if fn is None:
+                continue
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id in self._BUILTINS
+                    and node.args
+                    and not all(isinstance(a, ast.Constant)
+                                for a in node.args)):
+                out.append(ctx.finding(
+                    self, node,
+                    f"{f.id}() inside jitted '{fn.name}' pulls the value "
+                    f"to host — use jnp ops (or hoist out of the jit)"))
+            elif (isinstance(f, ast.Attribute) and f.attr in self._METHODS
+                    and not node.args):
+                out.append(ctx.finding(
+                    self, node,
+                    f".{f.attr}() inside jitted '{fn.name}' pulls the "
+                    f"value to host — keep it a traced array"))
+            elif (isinstance(f, ast.Attribute)
+                    and f.attr in self._NUMPY_FUNCS
+                    and _attr_root(f) in _NUMPY_ALIASES):
+                out.append(ctx.finding(
+                    self, node,
+                    f"np.{f.attr}() inside jitted '{fn.name}' materializes "
+                    f"on host — use jnp.{f.attr} or hoist out of the jit"))
+        return out
+
+
+# -- J003 -------------------------------------------------------------------
+
+
+@register
+class TracedPythonBranch(Rule):
+    id = "J003"
+    name = "traced-python-branch"
+    description = ("Python if/while on a traced value inside a jitted "
+                   "function: either a tracer-bool error at trace time or "
+                   "a silent retrace per branch — use lax.cond/lax.select")
+
+    # parameters with these fragments are static config, not traced arrays
+    _STATIC_HINTS = ("name", "axis", "mode", "dtype", "shape", "static",
+                     "interpret", "config", "cfg", "spec")
+
+    def _is_static_param(self, name: str) -> bool:
+        n = name.lower()
+        return n == "self" or any(h in n for h in self._STATIC_HINTS)
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            fn = ctx.in_jitted_scope(node)
+            if fn is None:
+                continue
+            why = self._traced_test(node.test, fn)
+            if why:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(ctx.finding(
+                    self, node,
+                    f"Python {kind} on {why} inside jitted '{fn.name}' — "
+                    f"use jax.lax.cond/select (or make the arg static)"))
+        return out
+
+    def _traced_test(self, test: ast.AST, fn) -> str | None:
+        # identity tests and isinstance are static dispatch — fine
+        for n in ast.walk(test):
+            if isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                return None
+            if (isinstance(n, ast.Call)
+                    and call_name(n) in ("isinstance", "hasattr",
+                                         "getattr", "len")):
+                return None
+        params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                  + fn.args.posonlyargs)
+                  if not self._is_static_param(a.arg)}
+        for n in ast.walk(test):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                    and _attr_root(n.func) in _JNP_ALIASES):
+                return f"a {_attr_root(n.func)}.* result"
+            if isinstance(n, ast.Compare):
+                sides = [n.left] + list(n.comparators)
+                for s in sides:
+                    if isinstance(s, ast.Name) and s.id in params:
+                        return f"traced arg '{s.id}'"
+                    # ts.step > 0: a field of a traced arg is traced too
+                    if isinstance(s, ast.Attribute) \
+                            and _attr_root(s) in params:
+                        return f"traced arg '{_attr_root(s)}'"
+        return None
+
+
+# -- J004 -------------------------------------------------------------------
+
+
+_KEY_SOURCE_ATTRS = {"split", "PRNGKey", "fold_in"}
+# params opt into tracking by JAX's `key` convention only — `rng` is the
+# numpy.random.Generator convention, where reuse is the whole point
+_KEY_NAME_RE = re.compile(r"key", re.IGNORECASE)
+
+
+def _is_key_source(call: ast.Call) -> bool:
+    """jax.random.split / .key / .PRNGKey / .fold_in (any random alias)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _KEY_SOURCE_ATTRS:
+        return True
+    if f.attr == "key":
+        # jax.random.key(...) but not cfg.key(...): require a random-ish
+        # receiver
+        recv = f.value
+        recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else "")
+        return "random" in recv_name or recv_name in ("jr", "jrandom")
+    return False
+
+
+@register
+class PRNGKeyReuse(Rule):
+    id = "J004"
+    name = "prng-key-reuse"
+    description = ("a PRNG key consumed more than once (or consumed inside "
+                   "a loop without a per-iteration split): correlated "
+                   "randomness silently corrupts exploration and "
+                   "prioritized sampling")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for fn in ctx.functions:
+            # skip nested defs: the enclosing function's scan covers them
+            # (their free-variable key uses belong to the outer scope)
+            if ctx.enclosing_function(fn) is not None:
+                continue
+            out.extend(_scan_function_keys(self, ctx, fn))
+        return out
+
+
+def _terminates(body) -> bool:
+    """A statement list that cannot fall through."""
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)) for s in body)
+
+
+def _scan_function_keys(rule: Rule, ctx: ModuleContext, fn) -> list[Finding]:
+    """Source-order scan of one function (including nested defs): track key
+    variables, count consumptions, flag the second use and any
+    loop-enclosed use whose key was made outside the loop."""
+    findings: list[Finding] = []
+    # name -> (assignment node, uses-so-far)
+    keys: dict[str, list] = {}
+    for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs:
+        if _KEY_NAME_RE.search(a.arg):
+            keys[a.arg] = [fn, 0]
+
+    def names_in(node: ast.AST, bound: frozenset = frozenset()):
+        """Free names in an argument expression.  Does NOT descend into
+        nested calls (``env.step(act(obs, k))`` charges k to ``act``
+        alone) and drops names rebound by comprehension targets or lambda
+        params along the way (``{k: float(v) for k, v in m.items()}``
+        consumes no outer ``k``)."""
+        out: set[str] = set()
+        if isinstance(node, ast.Name):
+            if node.id not in bound:
+                out.add(node.id)
+        elif isinstance(node, ast.Call):
+            pass                      # every call owns its own args
+        elif isinstance(node, ast.Subscript):
+            pass                      # keys[i] picks one subkey from a
+            #                           pre-split batch — not a reuse
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            b2 = set(bound)
+            for g in node.generators:
+                b2 |= {t.id for t in ast.walk(g.target)
+                       if isinstance(t, ast.Name)}
+            for c in ast.iter_child_nodes(node):
+                out |= names_in(c, frozenset(b2))
+        elif isinstance(node, ast.Lambda):
+            b2 = frozenset(bound | {p.arg for p in
+                                    (node.args.args + node.args.kwonlyargs
+                                     + node.args.posonlyargs)})
+            out |= names_in(node.body, b2)
+        else:
+            for c in ast.iter_child_nodes(node):
+                out |= names_in(c, bound)
+        return out
+
+    def consume(name: str, at: ast.AST) -> None:
+        entry = keys.get(name)
+        if entry is None:
+            return
+        entry[1] += 1
+        assigned_at, uses = entry
+        if uses >= 2:
+            findings.append(ctx.finding(
+                rule, at,
+                f"PRNG key '{name}' consumed again without "
+                f"jax.random.split — every consumer needs a fresh subkey"))
+            entry[1] = 1          # re-arm so each extra reuse flags once
+            return
+        loops = _loops_between(ctx, at, None)
+        assign_loops = set(map(id, _loops_between(ctx, assigned_at, None)))
+        if any(id(lp) not in assign_loops for lp in loops):
+            findings.append(ctx.finding(
+                rule, at,
+                f"PRNG key '{name}' consumed inside a loop but created "
+                f"outside it — split a fresh subkey per iteration"))
+            entry[1] = 0          # one report per site, not one per use
+
+    def comp_bound(at: ast.AST, name: str) -> bool:
+        """True when ``name`` is rebound by an enclosing comprehension
+        target or lambda parameter — it shadows the outer key there."""
+        for a in ctx.ancestors(at):
+            if isinstance(a, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                for g in a.generators:
+                    if any(isinstance(t, ast.Name) and t.id == name
+                           for t in ast.walk(g.target)):
+                        return True
+            elif isinstance(a, ast.Lambda):
+                if any(p.arg == name for p in
+                       (a.args.args + a.args.kwonlyargs
+                        + a.args.posonlyargs)):
+                    return True
+            elif isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        return False
+
+    def visit_call(node: ast.Call) -> None:
+        if _is_key_source(node):
+            return                # split/fold_in refresh, not a consumption
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            for name in names_in(arg):
+                if name in keys and not comp_bound(node, name):
+                    consume(name, node)
+
+    def assign_targets(targets, value) -> None:
+        from_key_source = isinstance(value, ast.Call) \
+            and _is_key_source(value)
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if not isinstance(e, ast.Name):
+                    continue
+                if from_key_source or (e.id in keys):
+                    if from_key_source:
+                        keys[e.id] = [e, 0]
+                    else:
+                        keys.pop(e.id, None)    # overwritten by non-key
+
+    def walk_expr(node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                visit_call(n)
+
+    def visit_stmt(stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            walk_expr(stmt.value)
+            assign_targets(stmt.targets, stmt.value)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                walk_expr(stmt.value)
+            assign_targets([stmt.target], stmt.value or stmt)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            walk_expr(stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                visit_stmt(s)
+        elif isinstance(stmt, ast.While):
+            walk_expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                visit_stmt(s)
+        elif isinstance(stmt, ast.If):
+            # if/else branches are mutually exclusive: one consumption in
+            # each branch is one consumption at runtime, not two.  A
+            # branch that terminates (return/raise/...) contributes
+            # nothing to the fall-through path.
+            walk_expr(stmt.test)
+            snap = {k: list(v) for k, v in keys.items()}
+            for s in stmt.body:
+                visit_stmt(s)
+            after_body = {k: list(v) for k, v in keys.items()}
+            keys.clear()
+            keys.update({k: list(v) for k, v in snap.items()})
+            for s in stmt.orelse:
+                visit_stmt(s)
+            if not _terminates(stmt.body):
+                for name, entry in after_body.items():
+                    if name in keys:
+                        keys[name][1] = max(keys[name][1], entry[1])
+                    else:
+                        keys[name] = entry
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                walk_expr(item.context_expr)
+            for s in stmt.body:
+                visit_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody
+                      + [h for hh in stmt.handlers for h in hh.body]):
+                visit_stmt(s)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: free-variable key uses count against the outer
+            # scope, but its own params SHADOW same-named outer keys and
+            # get their own fresh reuse budget
+            params = (stmt.args.args + stmt.args.kwonlyargs
+                      + stmt.args.posonlyargs)
+            shadowed = {a.arg: keys.pop(a.arg) for a in params
+                        if a.arg in keys}
+            own = [a.arg for a in params if _KEY_NAME_RE.search(a.arg)]
+            for name in own:
+                keys[name] = [stmt, 0]
+            for s in stmt.body:
+                visit_stmt(s)
+            for name in own:
+                keys.pop(name, None)
+            keys.update(shadowed)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if getattr(stmt, "value", None) is not None:
+                walk_expr(stmt.value)
+        else:
+            walk_expr(stmt)
+
+    for s in fn.body:
+        visit_stmt(s)
+    return findings
+
+
+# -- J005 -------------------------------------------------------------------
+
+
+@register
+class JitInLoop(Rule):
+    id = "J005"
+    name = "jit-in-loop"
+    description = ("jax.jit(...) invoked inside a loop body: builds a fresh "
+                   "wrapper (and usually retraces) every iteration — hoist "
+                   "the jitted callable out of the loop")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)):
+                continue
+            if _loops_between(ctx, node, None):
+                out.append(ctx.finding(
+                    self, node,
+                    "jax.jit called inside a loop body — hoist it; each "
+                    "call builds a new wrapper and retraces"))
+        return out

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Canonical lint entry point (mirrors scripts/test.sh).
+#
+# PALLAS_AXON_POOL_IPS must be cleared BEFORE the interpreter starts: the
+# image's sitecustomize dials the single-client axon TPU relay at python
+# startup, and a lint run would block forever if any other process holds
+# the chip.  apexlint itself is pure stdlib (it never imports JAX), so the
+# env discipline is about interpreter startup, not the analyzer.
+#
+# Usage: scripts/lint.sh [paths...] [--strict] [--json] [--write-baseline]
+# No args = the [tool.apexlint] scope from pyproject.toml, strict mode
+# (new findings AND stale baseline entries fail).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [ "$#" -eq 0 ]; then
+    set -- --strict
+fi
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m apex_tpu.analysis "$@"

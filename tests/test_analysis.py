@@ -1,0 +1,694 @@
+"""apexlint (apex_tpu/analysis) — rule fixtures, engine behavior, CLI.
+
+Every rule has a firing (bad) and a non-firing (good) fixture: the pair IS
+the rule's behavioral contract — heuristics may evolve, these pairs must
+keep holding.  A self-check at the bottom asserts the repo itself lints
+clean against the checked-in baseline, so the CI gate and this suite can
+never drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from apex_tpu.analysis import (Baseline, all_rules, analyze_source)
+from apex_tpu.analysis.cli import load_config, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rule(src: str, rule_id: str):
+    """Findings of ONE rule on a dedented source snippet."""
+    rules = {rule_id: all_rules()[rule_id]}
+    findings, _ = analyze_source(textwrap.dedent(src), path="fix.py",
+                                 rules=rules)
+    return findings
+
+
+def fires(src: str, rule_id: str) -> bool:
+    return any(f.rule == rule_id for f in run_rule(src, rule_id))
+
+
+# -- J001: jit without donation on step functions ---------------------------
+
+def test_j001_fires_on_undonated_train_step():
+    assert fires("""
+        import jax
+        class Core:
+            def jit_train_step(self):
+                return jax.jit(self.train_step)
+        """, "J001")
+
+
+def test_j001_silent_with_donation():
+    assert not fires("""
+        import jax
+        class Core:
+            def jit_train_step(self):
+                return jax.jit(self.train_step, donate_argnums=(0, 1))
+        """, "J001")
+
+
+def test_j001_silent_on_policy_fn():
+    # params are reused across calls — donation would be wrong, and the
+    # rule must not demand it
+    assert not fires("""
+        import jax
+        policy = jax.jit(make_policy_fn(model))
+        act = jax.jit(policy_fn)
+        """, "J001")
+
+
+def test_j001_decorator_forms():
+    assert fires("""
+        import jax
+        @jax.jit
+        def fused_train_step(ts, rs, batch):
+            return ts
+        """, "J001")
+    assert not fires("""
+        from functools import partial
+        import jax
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def fused_train_step(ts, rs, batch):
+            return ts
+        """, "J001")
+
+
+def test_j001_fires_on_ingest():
+    assert fires("""
+        import jax
+        step = jax.jit(ingest)
+        """, "J001")
+
+
+# -- J002: host sync inside jitted code -------------------------------------
+
+def test_j002_fires_on_float_in_jit():
+    assert fires("""
+        import jax
+        @jax.jit
+        def train_step(ts, batch):
+            lr = float(ts.lr)
+            return lr
+        """, "J002")
+
+
+def test_j002_fires_on_item_and_asarray():
+    src = """
+        import jax
+        import numpy as np
+        @jax.jit
+        def train_step(ts, batch):
+            a = ts.loss.item()
+            b = np.asarray(batch)
+            return a, b
+        """
+    got = {f.line for f in run_rule(src, "J002")}
+    assert len(got) == 2
+
+
+def test_j002_silent_outside_jit():
+    # the host-side driver loop is ALLOWED to sync — that's its job
+    assert not fires("""
+        import numpy as np
+        def add_step(self, q):
+            return float(np.max(q))
+        """, "J002")
+
+
+def test_j002_silent_on_constants():
+    assert not fires("""
+        import jax
+        @jax.jit
+        def train_step(ts):
+            return ts.x * float(1e-3)
+        """, "J002")
+
+
+def test_j002_sees_jit_call_sites_not_just_decorators():
+    assert fires("""
+        import jax
+        def train_step(ts, batch):
+            return float(ts.loss)
+        step = jax.jit(train_step, donate_argnums=(0,))
+        """, "J002")
+
+
+def test_j002_sees_transitive_callees():
+    # train_step is jitted and calls helper: helper is traced too
+    assert fires("""
+        import jax
+        def helper(x):
+            return float(x)
+        def train_step(ts):
+            return helper(ts.x)
+        step = jax.jit(train_step)
+        """, "J002")
+
+
+def test_j002_sees_make_fn_factory_closures():
+    # the repo convention: make_*_fn closures get jitted at call sites in
+    # OTHER modules — the factory body must count as jitted scope
+    assert fires("""
+        def make_policy_fn(model):
+            def policy(params, obs):
+                return float(model.apply(params, obs))
+            return policy
+        """, "J002")
+
+
+# -- J003: Python control flow on traced values -----------------------------
+
+def test_j003_fires_on_param_comparison():
+    assert fires("""
+        import jax
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """, "J003")
+
+
+def test_j003_fires_on_jnp_test():
+    assert fires("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(mask):
+            while jnp.any(mask):
+                mask = update(mask)
+            return mask
+        """, "J003")
+
+
+def test_j003_fires_on_traced_param_attribute():
+    # ts.step is a field of the traced state, traced itself
+    assert fires("""
+        import jax
+        @jax.jit
+        def train_step(ts):
+            if ts.step > 0:
+                return ts
+            return ts
+        """, "J003")
+
+
+def test_j003_silent_on_static_dispatch():
+    # `is None` / isinstance / static-hint params are config branching
+    assert not fires("""
+        import jax
+        @jax.jit
+        def step(x, axis_name=None, mode="a"):
+            if axis_name is not None:
+                x = psum(x, axis_name)
+            if mode == "a":
+                return x
+            return -x
+        """, "J003")
+
+
+def test_j003_silent_outside_jit():
+    assert not fires("""
+        def host_loop(reward):
+            if reward > 0:
+                return reward
+        """, "J003")
+
+
+# -- J004: PRNG key reuse ---------------------------------------------------
+
+def test_j004_fires_on_double_use():
+    assert fires("""
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """, "J004")
+
+
+def test_j004_silent_after_split():
+    assert not fires("""
+        import jax
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.normal(k2, (2,))
+            return a + b
+        """, "J004")
+
+
+def test_j004_fires_on_loop_reuse():
+    assert fires("""
+        import jax
+        def f(key):
+            out = []
+            for _ in range(4):
+                out.append(jax.random.normal(key, (2,)))
+            return out
+        """, "J004")
+
+
+def test_j004_silent_on_per_iteration_split():
+    assert not fires("""
+        import jax
+        def f(key):
+            out = []
+            for _ in range(4):
+                key, k = jax.random.split(key)
+                out.append(jax.random.normal(k, (2,)))
+            return out
+        """, "J004")
+
+
+def test_j004_silent_on_branch_exclusive_use():
+    # if/else (and early-return fall-through) arms each use the key once
+    assert not fires("""
+        import jax
+        def f(key, discrete):
+            if discrete:
+                return jax.random.categorical(key, logits)
+            return jax.random.normal(key, (2,))
+        """, "J004")
+
+
+def test_j004_silent_on_indexed_key_batch():
+    assert not fires("""
+        import jax
+        def f(key):
+            keys = jax.random.split(key, 8)
+            out = []
+            for i in range(8):
+                out.append(jax.random.normal(keys[i], (2,)))
+            return out
+        """, "J004")
+
+
+def test_j004_silent_on_comprehension_shadowing():
+    assert not fires("""
+        import jax
+        def f(key, metrics):
+            key, k = jax.random.split(key)
+            use(k)
+            return {k: float(v) for k, v in metrics.items()}
+        """, "J004")
+
+
+def test_j004_silent_on_numpy_generator_param():
+    # `rng` is the numpy.random.Generator convention: stateful, reuse is
+    # the point — only jax `key` params opt into tracking
+    assert not fires("""
+        def f(rng):
+            a = helper(rng)
+            b = helper(rng)
+            return a, b
+        """, "J004")
+
+
+def test_j004_fires_in_nested_def_scope():
+    assert fires("""
+        import jax
+        def outer():
+            def sample(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            return sample
+        """, "J004")
+
+
+# -- J005: jit inside a loop ------------------------------------------------
+
+def test_j005_fires_in_loop():
+    assert fires("""
+        import jax
+        def run(fns, x):
+            for fn in fns:
+                y = jax.jit(fn)(x)
+            return y
+        """, "J005")
+
+
+def test_j005_silent_outside_loop():
+    assert not fires("""
+        import jax
+        def run(fn, xs):
+            jfn = jax.jit(fn)
+            for x in xs:
+                y = jfn(x)
+            return y
+        """, "J005")
+
+
+# -- C001: process start after a live thread --------------------------------
+
+def test_c001_fires_on_fork_after_thread():
+    assert fires("""
+        import threading, multiprocessing
+        def boot(w, m):
+            t = threading.Thread(target=w)
+            t.start()
+            p = multiprocessing.Process(target=m)
+            p.start()
+        """, "C001")
+
+
+def test_c001_silent_with_spawn_context():
+    assert not fires("""
+        import threading, multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        def boot(w, m):
+            t = threading.Thread(target=w)
+            t.start()
+            p = ctx.Process(target=m)
+            p.start()
+        """, "C001")
+
+
+def test_c001_exactly_one_finding_not_duplicated_at_module_scope():
+    findings = run_rule("""
+        import threading, multiprocessing
+        def boot(w, m):
+            t = threading.Thread(target=w)
+            t.start()
+            p = multiprocessing.Process(target=m)
+            p.start()
+        """, "C001")
+    assert len(findings) == 1
+
+
+def test_c001_silent_across_separate_functions():
+    # runtime order of two functions is unknowable statically
+    assert not fires("""
+        import threading, multiprocessing
+        def a(w):
+            t = threading.Thread(target=w)
+            t.start()
+        def b(m):
+            p = multiprocessing.Process(target=m)
+            p.start()
+        """, "C001")
+
+
+def test_c001_silent_processes_first():
+    assert not fires("""
+        import threading, multiprocessing
+        def boot(w, m):
+            p = multiprocessing.Process(target=m)
+            p.start()
+            t = threading.Thread(target=w)
+            t.start()
+        """, "C001")
+
+
+# -- C002: zmq socket lifecycle ---------------------------------------------
+
+def test_c002_fires_on_unclosed_local_socket():
+    assert fires("""
+        import zmq
+        def send(msg):
+            sock = zmq.Context.instance().socket(zmq.PUSH)
+            sock.send(msg)
+        """, "C002")
+
+
+def test_c002_silent_when_closed():
+    assert not fires("""
+        import zmq
+        def send(msg):
+            sock = zmq.Context.instance().socket(zmq.PUSH)
+            try:
+                sock.send(msg)
+            finally:
+                sock.close(linger=0)
+        """, "C002")
+
+
+def test_c002_fires_on_class_socket_without_teardown():
+    assert fires("""
+        import zmq
+        class Pub:
+            def __init__(self, ctx):
+                self.sock = ctx.socket(zmq.PUB)
+        """, "C002")
+
+
+def test_c002_silent_on_class_with_close():
+    assert not fires("""
+        import zmq
+        class Pub:
+            def __init__(self, ctx):
+                self.sock = ctx.socket(zmq.PUB)
+            def close(self):
+                self.sock.close(linger=0)
+        """, "C002")
+
+
+def test_c002_silent_when_socket_escapes():
+    # handed to another owner: the receiver's lifecycle problem
+    assert not fires("""
+        import zmq
+        def make(ctx, registry):
+            sock = ctx.socket(zmq.PUB)
+            registry.add(sock)
+        """, "C002")
+
+
+# -- C003: shm created without close/unlink ---------------------------------
+
+def test_c003_fires_on_leaked_segment():
+    assert fires("""
+        def make(name):
+            ring = ShmRing(name, slot_size=64, n_slots=8, create=True)
+            ring.push(b"x")
+        """, "C003")
+
+
+def test_c003_silent_when_closed():
+    assert not fires("""
+        def make(name):
+            ring = ShmRing(name, slot_size=64, n_slots=8, create=True)
+            try:
+                ring.push(b"x")
+            finally:
+                ring.close()
+        """, "C003")
+
+
+def test_c003_silent_on_open_not_create():
+    assert not fires("""
+        def peek(name):
+            ring = ShmRing(name)
+            return ring.pending()
+        """, "C003")
+
+
+# -- C004: unlink from a non-creator ----------------------------------------
+
+def test_c004_fires_on_foreign_unlink():
+    assert fires("""
+        from multiprocessing import shared_memory
+        def drop(name):
+            seg = shared_memory.SharedMemory(name, create=False)
+            seg.unlink()
+        """, "C004")
+
+
+def test_c004_silent_for_creator():
+    assert not fires("""
+        from multiprocessing import shared_memory
+        def make(name):
+            seg = shared_memory.SharedMemory(name, create=True, size=64)
+            seg.unlink()
+        """, "C004")
+
+
+def test_c004_silent_under_owner_guard():
+    # ring.py contract: runtime-determined ownership gates unlink
+    assert not fires("""
+        class Facade:
+            def __init__(self, name):
+                self._ring = ShmRing(name)
+            def close(self):
+                if self._owner:
+                    self._ring.unlink()
+        """, "C004")
+
+
+def test_c004_fires_on_unguarded_class_unlink():
+    assert fires("""
+        class Facade:
+            def __init__(self, name):
+                self._ring = ShmRing(name)
+            def close(self):
+                self._ring.unlink()
+        """, "C004")
+
+
+# -- engine: parse errors, suppressions, baseline ---------------------------
+
+def test_parse_error_is_a_finding():
+    findings, _ = analyze_source("def broken(:\n", path="x.py")
+    assert [f.rule for f in findings] == ["E001"]
+
+
+def test_inline_suppression_with_justification():
+    src = textwrap.dedent("""
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))  # apexlint: disable=J004 -- deliberate same-draw
+            return a + b
+        """)
+    findings, suppressed = analyze_source(src, path="x.py")
+    assert not any(f.rule == "J004" for f in findings)
+    assert any(f.rule == "J004" for f in suppressed)
+
+
+def test_standalone_suppression_covers_next_line():
+    src = textwrap.dedent("""
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            # apexlint: disable=J004 -- deliberate same-draw
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    findings, suppressed = analyze_source(src, path="x.py")
+    assert not any(f.rule == "J004" for f in findings)
+    assert len(suppressed) == 1
+
+
+def test_suppression_is_rule_scoped():
+    # a J004 disable must NOT hide a J002 on the same line
+    src = textwrap.dedent("""
+        import jax
+        @jax.jit
+        def train_step(ts, key):
+            a = jax.random.normal(key, (2,))
+            b = float(jax.random.normal(key, (2,)).sum())  # apexlint: disable=J004
+            return a, b
+        """)
+    findings, _ = analyze_source(src, path="x.py")
+    assert any(f.rule == "J002" for f in findings)
+    assert not any(f.rule == "J004" for f in findings)
+
+
+def test_baseline_partition_and_staleness():
+    src = textwrap.dedent("""
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    findings, _ = analyze_source(src, path="m.py")
+    assert findings
+    base = Baseline.from_findings(findings)
+    new, matched, stale = base.partition(findings)
+    assert not new and matched and not stale
+    # fixed code -> the entry goes stale (strict mode fails on it)
+    new, matched, stale = base.partition([])
+    assert not new and not matched and stale
+
+
+def test_baseline_line_number_drift_still_matches():
+    src = ("import jax\n"
+           "def f(key):\n"
+           "    a = jax.random.normal(key, (2,))\n"
+           "    b = jax.random.normal(key, (2,))\n"
+           "    return a + b\n")
+    findings, _ = analyze_source(src, path="m.py")
+    base = Baseline.from_findings(findings)
+    shifted, _ = analyze_source("# new header comment\n" + src, path="m.py")
+    new, matched, stale = base.partition(shifted)
+    assert not new and matched and not stale
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _write(tmp_path, name, content):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(content))
+    return str(p)
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    assert main([bad, "--no-baseline", "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"]["new"] == 1
+    assert out["findings"][0]["rule"] == "J004"
+
+    good = _write(tmp_path, "good.py", "x = 1\n")
+    assert main([good, "--no-baseline"]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main([str(tmp_path / "missing.py")]) == 2
+    assert main([bad, "--disable", "NOPE"]) == 2
+    assert main([bad, "--no-baseline", "--disable", "J004"]) == 0
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    base = str(tmp_path / "base.json")
+    assert main([bad, "--baseline", base, "--write-baseline"]) == 0
+    assert main([bad, "--baseline", base]) == 0          # accepted
+    capsys.readouterr()
+
+
+def test_pyproject_config_is_read():
+    cfg = load_config(REPO)
+    assert "apex_tpu" in cfg.get("paths", [])
+    assert cfg.get("baseline") == ".apexlint-baseline.json"
+
+
+def test_every_rule_has_registry_metadata():
+    rules = all_rules()
+    assert {"J001", "J002", "J003", "J004", "J005",
+            "C001", "C002", "C003", "C004"} <= set(rules)
+    for rid, rule in rules.items():
+        assert rule.id == rid and rule.name and rule.description
+
+
+# -- self-check: the repo lints clean against its baseline ------------------
+
+def test_repo_lints_clean_strict():
+    """The merge gate: zero unsuppressed findings, zero stale baseline
+    entries, over the configured [tool.apexlint] scope — exactly what CI
+    runs.  A subprocess so the CLI path (module main, config discovery,
+    baseline load) is exercised end to end."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--strict"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_acceptance_command_package_scope():
+    """`python -m apex_tpu.analysis apex_tpu/` exits 0 (the README/issue
+    invocation): the package itself carries zero findings, with no
+    baseline help needed."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "apex_tpu",
+         "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

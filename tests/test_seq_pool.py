@@ -121,6 +121,7 @@ def test_pooled_sample_parity_with_stacked():
 
     key = jax.random.key(3)
     sb, sw, si = s_spec.sample(s_state, key, 8, 0.5)
+    # apexlint: disable=J004 -- parity test: both layouts must sample with the identical key
     pb, pw, pi = p_spec.sample(p_state, key, 8, 0.5)
     np.testing.assert_array_equal(np.asarray(si), np.asarray(pi))
     for k in sb:
