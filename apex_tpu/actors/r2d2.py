@@ -82,7 +82,10 @@ def pooled_sequence_message(seqs: list[dict]) -> dict:
         frames[off:off + n] = arr[lo:hi].reshape(n, d)
         base[k] = off - lo           # message row of episode frame `lo`
         off += n
-    assert off <= kf_max, (off, kf_max)   # coverage <= t_total per seq
+    # off <= kf_max here: coverage is <= t_total rows per sequence, which
+    # SequenceBuilder guarantees at layout selection (stride <= t_total is
+    # a ValueError for pooled builders — survives `python -O`, where a
+    # pack-time assert would vanish)
 
     obs_ref = np.zeros((g, t_total), np.int32)
     for i, s in enumerate(seqs):

@@ -464,6 +464,89 @@ def _scan_function_keys(rule: Rule, ctx: ModuleContext, fn) -> list[Finding]:
     return findings
 
 
+# -- J006 -------------------------------------------------------------------
+
+
+_TIMING_CALLS = {"perf_counter", "monotonic", "perf_counter_ns",
+                 "monotonic_ns", "time", "time_ns"}
+
+
+def _is_trace_context(expr: ast.AST) -> bool:
+    """``with trace(...)`` / ``profiling.trace(...)`` /
+    ``jax.profiler.trace(...)`` — the sanctioned profiling scopes."""
+    if not isinstance(expr, ast.Call):
+        return False
+    name = call_name(expr) or ""
+    return name == "trace" or name.endswith("_trace")
+
+
+@register
+class HostSyncInHotLoop(Rule):
+    id = "J006"
+    name = "host-sync-in-hot-loop"
+    description = ("block_until_ready()/jax.device_get() inside a host-side "
+                   "loop outside profiling scopes: a full device drain per "
+                   "iteration serializes the async-dispatch pipeline the "
+                   "learner hot path depends on")
+
+    def _sync_kind(self, node: ast.Call) -> str | None:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "block_until_ready":
+            # jax.block_until_ready(x) and x.block_until_ready() alike
+            return ("jax.block_until_ready()"
+                    if _attr_root(f) in _JNP_ALIASES and node.args
+                    else ".block_until_ready()")
+        if f.attr == "device_get" and _attr_root(f) in _JNP_ALIASES:
+            return "jax.device_get()"
+        return None
+
+    def _in_profiling_scope(self, ctx: ModuleContext, node: ast.AST,
+                            loops: list) -> bool:
+        # (a) lexically under `with trace(...)`: an explicit profiler
+        # capture is allowed to fence the device
+        for a in ctx.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                if any(_is_trace_context(item.context_expr)
+                       for item in a.items):
+                    return True
+        # (b) a measurement harness: some enclosing loop's body reads the
+        # clock (bench-style `t0 = perf_counter(); ...; block_until_ready`)
+        # — timing a device fence is the one legitimate hot-loop sync
+        for loop in loops:
+            for sub in ast.walk(loop):
+                if (isinstance(sub, ast.Call)
+                        and call_name(sub) in _TIMING_CALLS):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._sync_kind(node)
+            if kind is None:
+                continue
+            if ctx.in_jitted_scope(node):
+                continue                     # J002's territory
+            loops = _loops_between(ctx, node, None)
+            if not loops:
+                continue
+            if self._in_profiling_scope(ctx, node, loops):
+                continue
+            out.append(ctx.finding(
+                self, node,
+                f"{kind} inside a host loop — a device drain per "
+                f"iteration stalls async dispatch; stage it off the hot "
+                f"loop (training/ingest_pipeline) or wrap the "
+                f"measurement in a profiling trace scope"))
+        return out
+
+
 # -- J005 -------------------------------------------------------------------
 
 

@@ -83,6 +83,23 @@ class LearnerConfig:
     # chips (training/learner.py:scan_fused_steps).  Both families (DQN
     # and AQL), single-shard only; on a dp>1 mesh it quietly stays at 1.
     scan_steps: int = 1
+    # Async ingest pipeline (training/ingest_pipeline.py): a staging thread
+    # drains worker chunks, merges ingest-only chunks into one payload, and
+    # device_puts the next dispatch's data into a bounded on-device ring
+    # while the current fused step runs — host decode, H2D staging, and
+    # device compute overlap instead of serializing.  Order-preserving and
+    # numerics-neutral (bit-parity pinned in tests/test_ingest_pipeline.py).
+    # Single-shard concurrent trainers only; dp>1 meshes and the
+    # single-process drivers quietly ignore it.  False = the serial loop.
+    ingest_pipeline: bool = True
+    # Staged-slot ring depth.  2 = classic double buffering (the next
+    # dispatch's data is in HBM while the current one runs); deeper rings
+    # buy nothing but memory and backpressure latency.
+    pipeline_depth: int = 2
+    # Max frame chunks coalesced into ONE ingest payload when the learner
+    # is not train-eligible (warmup fill / replay-ratio cap) — each merge
+    # of m chunks turns m dispatches + m H2D copies into one.
+    pipeline_merge: int = 8
 
 
 @dataclass(frozen=True)

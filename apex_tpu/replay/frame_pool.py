@@ -211,6 +211,14 @@ class FramePoolReplay(PERMethods):
         Pad rows (>= n_frames / n_trans, repeats of the last real row) are
         redirected onto the last real row's slot — identical duplicate
         writes, so nothing old is clobbered.
+
+        Optional ``epoch_off`` i32[K]: per-transition offset added to the
+        recorded frame epoch.  Merged payloads
+        (:func:`apex_tpu.training.ingest_pipeline.merge_chunk_messages`)
+        carry the cumulative frame offset of each transition's source
+        chunk here, so one merged ingest records the SAME per-transition
+        epochs a sequential chunk-by-chunk ingest would — bit-identical
+        staleness detection, pinned in tests/test_ingest_pipeline.py.
         """
         kf = chunk["frames"].shape[0]
         k = priorities.shape[0]
@@ -233,6 +241,10 @@ class FramePoolReplay(PERMethods):
                 raise ValueError(
                     f"chunk {ref} shape {tuple(chunk[ref].shape)} != "
                     f"({k}, {self.frame_stack})")
+        epoch_off = chunk.get("epoch_off")
+        if epoch_off is not None and tuple(epoch_off.shape) != (k,):
+            raise ValueError(
+                f"chunk epoch_off shape {tuple(epoch_off.shape)} != ({k},)")
         for name, shape in self.extra_spec:
             got = tuple(chunk["extras"][name].shape)
             if got != (k,) + tuple(shape):
@@ -260,6 +272,10 @@ class FramePoolReplay(PERMethods):
         sum_tree, min_tree = tree_ops.update_both(
             state.sum_tree, state.min_tree, tidx, p_alpha)
 
+        epoch = state.f_epoch
+        if epoch_off is not None:
+            epoch = epoch + epoch_off.astype(jnp.int32)
+
         return state.replace(
             frames=frames,
             extras={name: state.extras[name].at[tidx].set(
@@ -272,7 +288,7 @@ class FramePoolReplay(PERMethods):
                 chunk["discount"].astype(jnp.float32)),
             obs_ids=state.obs_ids.at[tidx].set(obs_ids),
             next_ids=state.next_ids.at[tidx].set(next_ids),
-            frame_epoch=state.frame_epoch.at[tidx].set(state.f_epoch),
+            frame_epoch=state.frame_epoch.at[tidx].set(epoch),
             sum_tree=sum_tree, min_tree=min_tree,
             pos=(state.pos + chunk["n_trans"]) % c,
             f_epoch=state.f_epoch + chunk["n_frames"],

@@ -356,6 +356,19 @@ class RemotePool:
 
     ``n_peers`` is the barrier head-count (actors + evaluators,
     ``learner.py:48-49`` counts the evaluator as actor "+1").
+
+    Thread affinity under the async ingest pipeline
+    (:mod:`apex_tpu.training.ingest_pipeline`): ``poll_chunks`` and
+    ``publish_params`` are both driven by the single STAGING thread —
+    ``poll_chunks`` reads a plain queue the receiver thread feeds (safe
+    from any one consumer), and the zmq PUB socket sees a clean
+    sequential handoff: built in :meth:`start` (caller thread), then used
+    only by the staging thread (every publish routes through the
+    pipeline, initial publish included), then closed in :meth:`cleanup`
+    after the trainer joins that thread.  zmq sockets tolerate exactly
+    this migrate-then-use-single-threaded pattern; what they cannot
+    tolerate — and what the routing above rules out — is concurrent use
+    from two threads.
     """
 
     comms: CommsConfig
@@ -391,6 +404,9 @@ class RemotePool:
             self.publisher.close()
 
     def publish_params(self, version: int, params) -> None:
+        if self.publisher is None:
+            raise RuntimeError("RemotePool.publish_params before start(): "
+                               "the PUB socket binds in start()")
         self.publisher.publish(version, params)
 
     def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:

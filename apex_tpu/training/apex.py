@@ -87,6 +87,15 @@ class ConcurrentTrainer(CheckpointableTrainer):
     _multi = None
     scan_steps = 1
     scan_dispatches = 0      # K-step dispatches taken (observability)
+    # async ingest pipeline (training/ingest_pipeline.py): live only
+    # inside train() when config.learner.ingest_pipeline and the learner
+    # is single-shard; _ingest_multi is the scan-of-ingests dispatch for
+    # slots the replay-ratio cap says to absorb without training
+    _pipeline = None
+    _pipeline_base = 0       # self.ingested when the pipeline started
+    _ingest_multi = None
+    _dispatch_gap = None
+    _pipeline_last_stats = None
     # checkpoint/log bookkeeping persists ACROSS train() calls: a driver
     # interleaving short train() bursts with eval must still hit its
     # save/log cadence (per-call resets would silence both whenever
@@ -98,6 +107,15 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
     def _publish(self) -> None:
         self.param_version += 1
+        if self._pipeline is not None:
+            # hand the staging thread an on-device COPY: the hot loop's
+            # next fused step donates train_state, which would invalidate
+            # the original buffers under the staging thread's device_get.
+            # The copy dispatch is async — no hot-loop drain (the serial
+            # path below drains the whole device pipeline per publish).
+            params = jax.tree.map(jnp.copy, self.train_state.params)
+            self._pipeline.publish(self.param_version, params)
+            return
         host_params = jax.device_get(self.train_state.params)
         self.pool.publish_params(self.param_version, host_params)
 
@@ -162,7 +180,32 @@ class ConcurrentTrainer(CheckpointableTrainer):
         cfg = self.cfg
         pool = self.pool
         target_steps = self.steps_rate.total + total_steps
-        pool.start()
+        from apex_tpu.utils.profiling import DispatchGapTimer
+        gap = self._dispatch_gap = DispatchGapTimer()
+        pipeline = None
+        if self._use_pipeline():
+            from apex_tpu.training.ingest_pipeline import IngestPipeline
+            pipeline = IngestPipeline(
+                pool,
+                depth=getattr(cfg.learner, "pipeline_depth", 2),
+                scan_steps=(self.scan_steps if self._multi is not None
+                            else 1),
+                merge_max=getattr(cfg.learner, "pipeline_merge", 8),
+                state_fn=self._pipeline_state,
+                capacity=getattr(self.replay, "capacity", None),
+                frame_capacity=getattr(self.replay, "f_capacity", None))
+            self._pipeline = pipeline
+            self._pipeline_base = self.ingested
+        try:
+            pool.start()
+        except BaseException:
+            self._pipeline = None      # never started; don't route to it
+            raise
+        if pipeline is not None:
+            # staging starts only once the pool is live: its thread owns
+            # every poll_chunks/publish_params call from here to stop()
+            # (see RemotePool's thread-affinity contract)
+            pipeline.start()
         try:
             self._publish()
             last_publish = time.monotonic()
@@ -191,79 +234,56 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 behind = (warm and self.min_train_ratio is not None
                           and consumed < self.ingested * self.min_train_ratio)
 
-                # scan dispatch (config.scan_steps > 1): ask for K chunks
-                # only when the learner can take all K steps within BOTH
-                # the ratio budget and the remaining total_steps contract
-                # ("run total_steps MORE updates" — a K-dispatch must not
-                # overshoot it) — exactly the chunk-backlog regime where
-                # dispatch latency, not data supply, bounds throughput
-                want = 1
-                if (self._multi is not None and warm
-                        and target_steps - self.steps_rate.total
-                        >= self.scan_steps
-                        and self.steps_rate.total + self.scan_steps - 1
-                        < budget):
-                    want = self.scan_steps
+                got_data = False
+                if pipeline is not None:
+                    # pipelined: consume ready-on-device slots; the
+                    # staging thread already polled/decoded/merged/staged
+                    # while the previous dispatch ran
+                    slot = None
+                    if not behind:
+                        slot = pipeline.poll_slot(
+                            timeout=0 if warm else 0.05)
+                    if slot is not None:
+                        got_data = True
+                        m = self._consume_slot(slot, warm, budget,
+                                               target_steps)
+                        if m is not None:
+                            metrics = m
+                else:
+                    # serial: scan dispatch (config.scan_steps > 1) asks
+                    # for K chunks only when the learner can take all K
+                    # steps within BOTH the ratio budget and the
+                    # remaining total_steps contract ("run total_steps
+                    # MORE updates" — a K-dispatch must not overshoot
+                    # it) — exactly the chunk-backlog regime where
+                    # dispatch latency, not data supply, bounds throughput
+                    want = 1
+                    if (self._multi is not None and warm
+                            and target_steps - self.steps_rate.total
+                            >= self.scan_steps
+                            and self.steps_rate.total + self.scan_steps - 1
+                            < budget):
+                        want = self.scan_steps
 
-                msgs = []
-                if not behind:
-                    msgs = pool.poll_chunks(want, timeout=0 if warm else 0.05)
-
-                if want > 1 and len(msgs) == want:
-                    # full scan batch: K chunks -> one device dispatch.
-                    # Betas are the per-step stack the single-dispatch
-                    # path would have produced (step i sees ingestion
-                    # through chunk i-1), so the annealing schedule is
-                    # dispatch-shape-invariant.
-                    payload, prios, n_new = stack_chunk_messages(msgs)
-                    n_per = np.asarray([int(m["n_trans"]) for m in msgs])
-                    offsets = np.concatenate([[0], np.cumsum(n_per)[:-1]])
-                    betas = np.asarray(
-                        [self._beta(self.ingested + int(o))
-                         for o in offsets], np.float32)
+                    msgs = []
+                    if not behind:
+                        msgs = pool.poll_chunks(want,
+                                                timeout=0 if warm else 0.05)
+                    if msgs:
+                        got_data = True
+                        m = self._drain_serial(msgs, want, warm, budget)
+                        if m is not None:
+                            metrics = m
+                if not got_data and warm \
+                        and self.steps_rate.total < budget:
                     self.key, k = jax.random.split(self.key)
-                    self.train_state, self.replay_state, mm = \
-                        self._multi(self.train_state, self.replay_state,
-                                    payload, prios,
-                                    jax.random.split(k, want), betas)
-                    # scalar observability coarsens to per-dispatch under
-                    # scan: report the mean over the K stacked steps
-                    metrics = jax.tree.map(lambda x: x.mean(0), mm)
-                    self.steps_rate.tick(want)
-                    self.scan_dispatches += 1
-                    self.ingested += n_new
-                    self.frames_rate.tick(n_new)
-                elif msgs:
-                    # single-chunk path (and scan shortfalls, one by one)
-                    for msg in msgs:
-                        prios = jnp.asarray(msg["priorities"])
-                        n_new = int(msg["n_trans"])
-                        payload = msg["payload"]
-                        # The replay-ratio cap applies on the chunk path
-                        # too: an over-budget learner ingests WITHOUT the
-                        # fused train half, so the documented
-                        # ``train_ratio`` really is the ceiling (ingesting
-                        # raises the budget for later steps).
-                        if warm and self.steps_rate.total < budget:
-                            self.key, k = jax.random.split(self.key)
-                            self.train_state, self.replay_state, metrics = \
-                                self._fused(self.train_state,
-                                            self.replay_state,
-                                            payload, prios, k,
-                                            jnp.float32(self._beta()))
-                            self.steps_rate.tick()
-                        else:
-                            self.replay_state = self._ingest(
-                                self.replay_state, payload, prios)
-                        self.ingested += n_new
-                        self.frames_rate.tick(n_new)
-                elif warm and self.steps_rate.total < budget:
-                    self.key, k = jax.random.split(self.key)
+                    gap.about_to_dispatch()
                     self.train_state, self.replay_state, metrics = \
                         self._train(self.train_state, self.replay_state, k,
                                     jnp.float32(self._beta()))
+                    gap.dispatch_returned()
                     self.steps_rate.tick()
-                elif warm:
+                elif not got_data and warm:
                     time.sleep(0.002)   # replay-ratio cap reached
 
                 steps = self.steps_rate.total
@@ -319,14 +339,24 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
                 if warm and metrics is not None \
                         and steps - self._last_log >= log_every:
+                    extra = gap.snapshot()
+                    if pipeline is not None:
+                        extra |= {f"pipeline_{k}": v
+                                  for k, v in pipeline.stats.items()}
                     self.log.scalars(
                         {k: float(v) for k, v in metrics.items()}
                         | {"bps": self.steps_rate.rate,
                            "fps": self.frames_rate.rate,
                            "param_version": self.param_version,
-                           "ingested": self.ingested}, steps)
+                           "ingested": self.ingested} | extra, steps)
                     self._last_log = steps
         finally:
+            if pipeline is not None:
+                # stop staging BEFORE the pool teardown (the staging
+                # thread is the pool's only chunk consumer while live)
+                self._pipeline_last_stats = dict(pipeline.stats)
+                pipeline.stop()
+                self._pipeline = None
             pool.cleanup()
             stop = self._stop_requested
             if stop is not None:
@@ -340,6 +370,170 @@ class ConcurrentTrainer(CheckpointableTrainer):
         n = self.ingested if ingested is None else ingested
         frac = min(1.0, n / max(1, self.cfg.replay.beta_anneal))
         return self.cfg.replay.beta + (1.0 - self.cfg.replay.beta) * frac
+
+    # -- async ingest pipeline (training/ingest_pipeline.py) ---------------
+
+    def _use_pipeline(self) -> bool:
+        """Pipeline staging applies to single-shard concurrent learners;
+        the dp>1 plan keeps the serial drain (whole-chunk round-robin
+        through ChunkAggregator is its own staging discipline)."""
+        return bool(getattr(self.cfg.learner, "ingest_pipeline", False)
+                    and getattr(self, "n_dp", 1) == 1)
+
+    def _pipeline_state(self):
+        """Counter snapshot for the staging thread's grouping decisions.
+        ``train_eligible`` is predicted with the pipeline's monotone
+        polled-transition total (plus the ingested count the pipeline
+        started from): when the chunk under consideration reaches the
+        front of the (order-preserving) pipeline, the trainer's
+        ``ingested`` will equal exactly that — so the prediction
+        reproduces the serial loop's per-chunk warm/budget gating, and a
+        merge group never straddles the warmup boundary (bit-parity
+        depends on this)."""
+        from apex_tpu.training.ingest_pipeline import PipelineState
+        cfg = self.cfg
+        pipe = self._pipeline
+        effective = self._pipeline_base + (0 if pipe is None
+                                           else pipe.polled_total())
+        consumed = self.steps_rate.total * self.core.batch_size
+        behind = (self.ingested >= cfg.replay.warmup
+                  and self.min_train_ratio is not None
+                  and consumed < self.ingested * self.min_train_ratio)
+        # the step counter the chunk will MEET includes the train steps
+        # already staged ahead of it — without them every chunk queued
+        # behind one pending fused step looks budget-eligible and the
+        # ingest-only stream degrades to unmerged singles
+        steps_at_front = (self.steps_rate.total
+                          + (0 if pipe is None
+                             else pipe.staged_train_steps()))
+        budget_ok = (self.train_ratio is None
+                     or steps_at_front
+                     < effective * self.train_ratio / self.core.batch_size)
+        return PipelineState(
+            behind=behind,
+            train_eligible=effective >= cfg.replay.warmup and budget_ok)
+
+    def _consume_slot(self, slot, warm: bool, budget: float,
+                      target_steps: int):
+        """Dispatch one staged slot; returns metrics or None.  Mirrors
+        the serial drain's gating chunk for chunk: train-eligible singles
+        run the fused step, eligible scan stacks run the K-step scan
+        dispatch, everything else is absorbed ingest-only (the
+        replay-ratio cap is re-checked at consume time, so a stale
+        staging prediction can only under-train, never over-train)."""
+        gap = self._dispatch_gap
+        metrics = None
+        if slot.kind == "scan":
+            j = slot.chunks
+            trainable = (warm and self._multi is not None
+                         and self.steps_rate.total + j - 1 < budget
+                         and target_steps - self.steps_rate.total >= j)
+            if trainable:
+                offsets = np.concatenate(
+                    [[0], np.cumsum(slot.n_per)[:-1]])
+                betas = np.asarray(
+                    [self._beta(self.ingested + int(o)) for o in offsets],
+                    np.float32)
+                self.key, k = jax.random.split(self.key)
+                gap.about_to_dispatch()
+                self.train_state, self.replay_state, mm = \
+                    self._multi(self.train_state, self.replay_state,
+                                slot.payload, slot.prios,
+                                jax.random.split(k, j), betas)
+                gap.dispatch_returned()
+                metrics = jax.tree.map(lambda x: x.mean(0), mm)
+                self.steps_rate.tick(j)
+                self.scan_dispatches += 1
+            else:
+                if self._ingest_multi is None:
+                    from apex_tpu.training.learner import make_multi_ingest
+                    self._ingest_multi = make_multi_ingest(self.core)
+                gap.about_to_dispatch()
+                self.replay_state = self._ingest_multi(
+                    self.replay_state, slot.payload, slot.prios)
+                gap.dispatch_returned()
+        elif slot.kind == "single" and warm \
+                and self.steps_rate.total < budget:
+            self.key, k = jax.random.split(self.key)
+            gap.about_to_dispatch()
+            self.train_state, self.replay_state, metrics = \
+                self._fused(self.train_state, self.replay_state,
+                            slot.payload, slot.prios, k,
+                            jnp.float32(self._beta()))
+            gap.dispatch_returned()
+            self.steps_rate.tick()
+        else:
+            # merged ingest payloads, and singles the cap says to absorb
+            gap.about_to_dispatch()
+            self.replay_state = self._ingest(self.replay_state,
+                                             slot.payload, slot.prios)
+            gap.dispatch_returned()
+        self.ingested += slot.n_trans
+        self.frames_rate.tick(slot.n_trans)
+        return metrics
+
+    def _drain_serial(self, msgs: list, want: int, warm: bool,
+                      budget: float):
+        """The serial (pipeline-off) drain of one poll's messages.
+        Returns metrics or None."""
+        gap = self._dispatch_gap
+        metrics = None
+        if want > 1 and len(msgs) > 1:
+            # scan batch: j chunks -> one device dispatch, quantized to a
+            # power of two so shortfalls (j < K) compile O(log K) scan
+            # programs instead of degrading to j separate dispatches;
+            # the remainder falls through to the per-chunk path IN ORDER.
+            # Betas are the per-step stack the single-dispatch path would
+            # have produced (step i sees ingestion through chunk i-1), so
+            # the annealing schedule is dispatch-shape-invariant.
+            from apex_tpu.training.ingest_pipeline import _pow2_floor
+            j = _pow2_floor(len(msgs))
+            take, msgs = msgs[:j], msgs[j:]
+            payload, prios, n_new = stack_chunk_messages(take)
+            n_per = np.asarray([int(m["n_trans"]) for m in take])
+            offsets = np.concatenate([[0], np.cumsum(n_per)[:-1]])
+            betas = np.asarray(
+                [self._beta(self.ingested + int(o))
+                 for o in offsets], np.float32)
+            self.key, k = jax.random.split(self.key)
+            gap.about_to_dispatch()
+            self.train_state, self.replay_state, mm = \
+                self._multi(self.train_state, self.replay_state,
+                            payload, prios, jax.random.split(k, j), betas)
+            gap.dispatch_returned()
+            # scalar observability coarsens to per-dispatch under scan:
+            # report the mean over the j stacked steps
+            metrics = jax.tree.map(lambda x: x.mean(0), mm)
+            self.steps_rate.tick(j)
+            self.scan_dispatches += 1
+            self.ingested += n_new
+            self.frames_rate.tick(n_new)
+        for msg in msgs:
+            # single-chunk path (and scan spillover, one by one)
+            prios = jnp.asarray(msg["priorities"])
+            n_new = int(msg["n_trans"])
+            payload = msg["payload"]
+            # The replay-ratio cap applies on the chunk path too: an
+            # over-budget learner ingests WITHOUT the fused train half,
+            # so the documented ``train_ratio`` really is the ceiling
+            # (ingesting raises the budget for later steps).
+            if warm and self.steps_rate.total < budget:
+                self.key, k = jax.random.split(self.key)
+                gap.about_to_dispatch()
+                self.train_state, self.replay_state, metrics = \
+                    self._fused(self.train_state, self.replay_state,
+                                payload, prios, k,
+                                jnp.float32(self._beta()))
+                gap.dispatch_returned()
+                self.steps_rate.tick()
+            else:
+                gap.about_to_dispatch()
+                self.replay_state = self._ingest(
+                    self.replay_state, payload, prios)
+                gap.dispatch_returned()
+            self.ingested += n_new
+            self.frames_rate.tick(n_new)
+        return metrics
 
     # -- checkpointing (A4): format/IO in CheckpointableTrainer ------------
     # (restore note: the actor fleet re-syncs from the first post-restore

@@ -1,0 +1,480 @@
+"""Async ingest pipeline: overlap host decode, H2D staging, and compute.
+
+The serial concurrent loop (:meth:`ConcurrentTrainer.train`) does all of
+this on ONE thread, in sequence, per step: poll the chunk queue (pickle /
+shm decode), stack arrays with host numpy, hand host buffers to the jitted
+step (whose H2D copy runs synchronously inside the dispatch), then poll
+again.  Host decode, H2D transfer, and device compute therefore never
+overlap — the exact decoupling failure Ape-X exists to avoid (Horgan et
+al. 2018), and the standard fix is double-buffered staging (Stooke &
+Abbeel 2018, PAPERS.md "Accelerated Methods for Deep RL").
+
+This module runs a single background STAGING thread that:
+
+* drains ``pool.poll_chunks`` (the decode cost — mp.Queue pickle or shm
+  copy — moves off the hot loop with it);
+* groups chunks by what the trainer will do with them, predicted from the
+  live counters (``state_fn``):
+
+  - train-eligible chunks -> a ``lax.scan`` stack of j chunks (one
+    dispatch, j bit-identical fused steps) — this also fixes the serial
+    scan shortfall where j < scan_steps chunks degraded to j separate
+    dispatches;
+  - ingest-only chunks (warmup fill, replay-ratio cap) -> ONE merged
+    payload via :func:`merge_chunk_messages` — m dispatches and m H2D
+    copies become one, bit-identically (see below);
+
+* ``jax.device_put``\\ s each staged slot so the next dispatch's data is
+  already in HBM while the current fused step runs, into a bounded
+  depth-``depth`` ring (default 2: classic double buffering).
+
+Ordering / backpressure / numerics contract:
+
+* Chunks enter slots strictly in poll order and the ring is FIFO — the
+  replay sees the same transition stream as the serial loop.
+* The ring is BOUNDED and the staging thread polls nothing while it is
+  full (or while the replay-ratio floor says the learner is behind), so
+  the bounded worker chunk queue backpressures the actor fleet exactly as
+  before; the pipeline can hold at most ``depth`` slots plus one group in
+  flight.
+* Merging is numerics-free: :func:`merge_chunk_messages` rebases the
+  chunk-relative ``obs_ref``/``next_ref`` tables with cumulative frame
+  offsets and carries per-transition ``epoch_off`` so one merged
+  :meth:`FramePoolReplay.add` writes the SAME cells, priorities, and
+  epochs as ingesting the chunks one by one — exploiting the
+  duplicate-pad-write invariant (pads repeat the last real row, so they
+  remain deterministic no-ops after merging).  Bit-parity is pinned in
+  ``tests/test_ingest_pipeline.py``.
+
+Param publishes also ride the staging thread: the trainer hands over an
+on-device param copy and the thread performs the blocking
+``jax.device_get`` + serialization that used to drain the whole device
+pipeline from inside the hot loop (apexlint J006 now guards against that
+pattern coming back).
+"""
+
+from __future__ import annotations
+
+import queue as queue_lib
+import threading
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+#: payload keys that identify a self-contained frame chunk
+#: (replay/frame_chunks.py contract) — the only payload schema
+#: merge_chunk_messages understands.  Everything else (stacked AQL
+#: batches, R2D2 sequence messages) stages as single slots.
+FRAME_CHUNK_KEYS = frozenset((
+    "frames", "n_frames", "n_trans", "action", "reward", "discount",
+    "obs_ref", "next_ref"))
+
+
+def is_frame_chunk(payload) -> bool:
+    return isinstance(payload, dict) and FRAME_CHUNK_KEYS <= payload.keys()
+
+
+def merge_chunk_messages(msgs: list[dict]) -> dict:
+    """Merge m frame-chunk messages into ONE ingest message.
+
+    Real rows from every chunk are compacted front-to-back (frames and
+    transitions separately), ``obs_ref``/``next_ref`` are rebased by each
+    chunk's cumulative REAL frame offset, and ``epoch_off`` records that
+    same offset per transition so the pool stamps sequential-identical
+    frame epochs.  The tail pads by repeating the last real row —
+    priorities included — preserving the duplicate-pad-write invariant.
+    Output shapes are fixed per m (``[m*K]`` / ``[m*Kf, D]``), so each
+    distinct merge width compiles exactly one ingest program.
+
+    Bit-parity contract: ``add(merge(c1..cm))`` == ``add(c1); ...;
+    add(cm)`` on every :class:`FramePoolState` field (frames, id tables,
+    trees, epochs, cursors) — tests/test_ingest_pipeline.py.
+    """
+    if len(msgs) == 1:
+        return msgs[0]
+    payloads = [m["payload"] for m in msgs]
+    k = payloads[0]["action"].shape[0]
+    kf, d = payloads[0]["frames"].shape
+    stack = payloads[0]["obs_ref"].shape[1]
+    for p in payloads[1:]:
+        if (p["action"].shape[0] != k or p["frames"].shape != (kf, d)
+                or p["obs_ref"].shape[1] != stack):
+            raise ValueError("merge_chunk_messages needs uniform chunk "
+                             "shapes (one builder config per pool)")
+    m = len(msgs)
+    n_tr = [int(p["n_trans"]) for p in payloads]
+    n_fr = [int(p["n_frames"]) for p in payloads]
+    tot_tr, tot_fr = sum(n_tr), sum(n_fr)
+    out_k, out_kf = m * k, m * kf
+    # cumulative REAL frame offset of each source chunk — the ref rebase
+    # and the per-transition epoch offsets both come from this
+    cum_fr = np.concatenate(([0], np.cumsum(n_fr)[:-1])).astype(np.int64)
+
+    frames = np.empty((out_kf, d), payloads[0]["frames"].dtype)
+    off = 0
+    for p, nf in zip(payloads, n_fr):
+        frames[off:off + nf] = p["frames"][:nf]
+        off += nf
+    frames[tot_fr:] = frames[tot_fr - 1]
+
+    def cat(rows: list[np.ndarray], dtype) -> np.ndarray:
+        arr = np.concatenate(rows).astype(dtype, copy=False)
+        out = np.empty((out_k,) + arr.shape[1:], dtype)
+        out[:tot_tr] = arr
+        out[tot_tr:] = arr[tot_tr - 1]
+        return out
+
+    payload = dict(
+        frames=frames,
+        n_frames=np.int32(tot_fr),
+        n_trans=np.int32(tot_tr),
+        action=cat([p["action"][:nt] for p, nt in zip(payloads, n_tr)],
+                   np.int32),
+        reward=cat([p["reward"][:nt] for p, nt in zip(payloads, n_tr)],
+                   np.float32),
+        discount=cat([p["discount"][:nt] for p, nt in zip(payloads, n_tr)],
+                     np.float32),
+        obs_ref=cat([p["obs_ref"][:nt] + c
+                     for p, nt, c in zip(payloads, n_tr, cum_fr)], np.int32),
+        next_ref=cat([p["next_ref"][:nt] + c
+                      for p, nt, c in zip(payloads, n_tr, cum_fr)], np.int32),
+        epoch_off=cat([np.full(nt, c)
+                       for nt, c in zip(n_tr, cum_fr)], np.int32),
+    )
+    if "extras" in payloads[0]:
+        payload["extras"] = {
+            name: cat([p["extras"][name][:nt]
+                       for p, nt in zip(payloads, n_tr)], np.float32)
+            for name in payloads[0]["extras"]}
+    prios = cat([np.asarray(msg["priorities"])[:nt]
+                 for msg, nt in zip(msgs, n_tr)], np.float32)
+    return {"payload": payload, "priorities": prios, "n_trans": tot_tr}
+
+
+@dataclass
+class PipelineState:
+    """Trainer-counter snapshot the staging thread groups by.  ``behind``
+    mirrors the replay-ratio floor (pause draining so the bounded queue
+    backpressures the fleet); ``train_eligible`` predicts whether the
+    NEXT chunk will be trained on or absorbed ingest-only — computed from
+    the monotone :meth:`IngestPipeline.polled_total` (plus
+    :meth:`IngestPipeline.staged_train_steps` on the budget side) so the
+    prediction sees exactly what the serial loop's warm/budget gate would
+    see when that chunk reaches the front of the queue."""
+
+    behind: bool = False
+    train_eligible: bool = True
+
+
+@dataclass
+class StagedSlot:
+    """One ready-on-device unit of ingest work, in stream order.
+
+    kind:
+      ``"single"`` — one chunk, the fused-step shape;
+      ``"scan"``   — j chunks stacked on a leading axis for the
+                     lax.scan dispatch (``n_per`` holds per-chunk
+                     transition counts for the per-step beta stack);
+      ``"merged"`` — m chunks compacted into one ingest payload.
+    """
+
+    kind: str
+    payload: object
+    prios: object
+    n_trans: int
+    n_per: tuple[int, ...] = ()
+    chunks: int = 1
+    #: train steps this slot was STAGED to take (scan j / eligible single
+    #: 1 / ingest-only 0) — folded into the budget prediction so chunks
+    #: behind an unconsumed trainable slot see the step count they will
+    #: actually meet at the front of the queue
+    planned_steps: int = 0
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (max(1, n).bit_length() - 1)
+
+
+class IngestPipeline:
+    """The background staging stage (module docstring).
+
+    Construction does not start the thread; drive it with
+    :meth:`start` / :meth:`stop`.  Single producer (the staging thread),
+    single consumer (the trainer loop) — FIFO order is structural.
+    """
+
+    def __init__(self, pool, *, depth: int = 2, scan_steps: int = 1,
+                 merge_max: int = 8, state_fn=None,
+                 capacity: int | None = None,
+                 frame_capacity: int | None = None,
+                 poll_timeout: float = 0.01,
+                 put_device: bool | None = None):
+        self.pool = pool
+        self.depth = max(1, int(depth))
+        self.scan_steps = max(1, int(scan_steps))
+        self.merge_max = max(1, int(merge_max))
+        self.state_fn = state_fn or PipelineState
+        self.capacity = capacity
+        self.frame_capacity = frame_capacity
+        self.poll_timeout = poll_timeout
+        if put_device is None:
+            # pre-staging into device memory only pays when there IS a
+            # transfer to hide; on the CPU backend an explicit per-slot
+            # device_put costs more than the jit call's own zero-distance
+            # ingestion of numpy operands (measured ~150us/leaf)
+            put_device = jax.default_backend() != "cpu"
+        self._stage = jax.device_put if put_device else (lambda x: x)
+        self.put_device = put_device
+        self._ring: queue_lib.Queue = queue_lib.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        # set whenever the staging thread is parked with NOTHING in hand:
+        # poll_slot treats "ring empty + staging idle" as dry and may
+        # return None; while work is in flight it waits for the slot
+        # instead of letting the trainer burn a replay-only step on data
+        # that is milliseconds away (the serial loop's queue poll has the
+        # same preference for fresh data)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: BaseException | None = None
+        self._pub_lock = threading.Lock()
+        self._pub: tuple | None = None
+        self._ahead_lock = threading.Lock()
+        self._staged_ahead = 0          # transitions polled but not consumed
+        self._polled_total = 0          # transitions EVER polled (monotone)
+        self._staged_steps = 0          # planned train steps not yet consumed
+        self.stats = {"slots": 0, "scan_slots": 0, "merged_slots": 0,
+                      "merged_chunks": 0, "publishes": 0}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="apex-ingest-staging")
+
+    # -- trainer side ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def staged_ahead(self) -> int:
+        """Transitions the pipeline holds (staged or in flight) that the
+        trainer has not consumed yet — observability only."""
+        return self._staged_ahead
+
+    def polled_total(self) -> int:
+        """Transitions EVER polled off the pool — monotone, so the
+        warm/budget prediction in ``state_fn`` is race-free: when the
+        staging thread asks about the NEXT chunk, this is exactly the
+        transition count preceding it in the (order-preserved) stream,
+        i.e. the value the serial loop's per-chunk warm gate would see.
+        (``ingested + staged_ahead`` is the same quantity only between
+        consumptions — mid-consume it undercounts and a train-eligible
+        chunk could get merged into an ingest-only payload.)"""
+        return self._polled_total
+
+    def staged_train_steps(self) -> int:
+        """Train steps staged but not yet consumed: the budget prediction
+        adds these to the live step counter, else every chunk behind one
+        pending fused step looks budget-eligible and the ingest-only
+        stream degrades to unmerged singles."""
+        return self._staged_steps
+
+    def publish(self, version: int, params) -> None:
+        """Latest-wins async param publish: the staging thread performs
+        the blocking device_get + pool serialization.  ``params`` must be
+        a tree the hot loop will NOT donate later — the trainer hands an
+        on-device ``jnp.copy`` for exactly that reason."""
+        with self._pub_lock:
+            self._pub = (version, params)
+
+    def poll_slot(self, timeout: float = 0.0) -> StagedSlot | None:
+        """Next ready slot in stream order, or None when the pipeline is
+        dry (no slot staged, none in flight, and the pool poll came up
+        empty) and ``timeout`` has elapsed."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                # blocking get: a condition-variable wakeup on put, not a
+                # sleep-quantum poll (matters on few-core hosts where the
+                # staging and consumer threads share the GIL)
+                slot = self._ring.get(timeout=0.005)
+            except queue_lib.Empty:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "ingest pipeline staging thread died"
+                    ) from self._error
+                if self._stop.is_set():
+                    return None
+                if self._idle.is_set() and time.monotonic() >= deadline:
+                    return None
+                continue
+            with self._ahead_lock:
+                self._staged_ahead -= slot.n_trans
+                self._staged_steps -= slot.planned_steps
+            return slot
+
+    # -- staging thread ----------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._serve_publish()
+                # NOTE: no ring-full pre-check — the blocking _put IS the
+                # backpressure (bound: depth slots + one group in flight),
+                # and a condition-variable wakeup hands the consumer the
+                # next slot immediately where a sleep-poll would add a
+                # millisecond quantum per slot
+                st = self.state_fn()
+                if st.behind:
+                    # replay-ratio floor: pause draining so the bounded
+                    # worker queue backpressures the actor fleet
+                    self._idle.set()
+                    time.sleep(0.002)
+                    continue
+                msgs = self._poll(1, timeout=self.poll_timeout)
+                if not msgs:
+                    self._idle.set()
+                    continue
+                self._idle.clear()
+                slot = self._build_slot(msgs[0], st)
+                self._put(slot)
+        except BaseException as exc:      # surface to poll_slot, loudly
+            self._error = exc
+            self._idle.set()
+
+    def _poll(self, n: int, timeout: float = 0.0) -> list:
+        msgs = self.pool.poll_chunks(n, timeout=timeout)
+        if msgs:
+            n_trans = sum(int(m["n_trans"]) for m in msgs)
+            with self._ahead_lock:
+                self._staged_ahead += n_trans
+                self._polled_total += n_trans
+        return msgs
+
+    def _build_slot(self, first: dict, st: PipelineState) -> StagedSlot:
+        """Group ``first`` with immediately-available successors into one
+        staged slot, honoring stream order and the predicted consume
+        mode."""
+        if st.train_eligible and self.scan_steps > 1:
+            return self._build_scan_slot(first)
+        if not st.train_eligible:
+            cap = self._merge_cap(first["payload"])
+            if cap > 1:
+                return self._build_merged_slot(first)
+        return self._single_slot(first,
+                                 planned=1 if st.train_eligible else 0)
+
+    def _build_scan_slot(self, first: dict) -> StagedSlot:
+        from apex_tpu.parallel.aggregate import stack_chunk_messages
+        msgs = [first] + self._poll(self.scan_steps - 1, timeout=0)
+        # quantize to powers of two so scan-shortfall widths compile
+        # O(log K) programs, not one per j; leftovers become singles in
+        # order (never reordered past the stack)
+        j = _pow2_floor(len(msgs))
+        take, rest = msgs[:j], msgs[j:]
+        if j == 1:
+            slot = self._single_slot(take[0])
+        else:
+            payload, prios, n_new = stack_chunk_messages(take)
+            slot = StagedSlot(
+                kind="scan", payload=self._stage(payload),
+                prios=self._stage(prios), n_trans=n_new,
+                n_per=tuple(int(m["n_trans"]) for m in take), chunks=j,
+                planned_steps=j)
+            with self._ahead_lock:
+                self._staged_steps += j
+            self.stats["scan_slots"] += 1
+            self.stats["slots"] += 1
+        for msg in rest:                 # order-preserving spillover
+            self._put(slot)
+            slot = self._single_slot(msg, planned=1)
+        return slot
+
+    def _build_merged_slot(self, first: dict) -> StagedSlot:
+        cap = self._merge_cap(first["payload"])
+        msgs = [first]
+        # extend only while the NEXT chunk is still predicted ingest-only:
+        # polled_total already counts everything in msgs, so state_fn sees
+        # the effective warm/budget position of the chunk about to join —
+        # a merge group never straddles the warmup (or budget) boundary
+        while len(msgs) < cap:
+            st = self.state_fn()
+            if st.train_eligible:
+                break
+            more = self._poll(1, timeout=0)
+            if not more:
+                break
+            msgs.extend(more)
+        # quantize merge widths to powers of two (like the scan widths):
+        # every distinct ingest shape is one XLA compile, and arbitrary
+        # widths would scatter compiles across the whole run — O(log
+        # merge_max) programs total instead.  Spillover stays in order.
+        slot = None
+        while msgs:
+            j = _pow2_floor(min(len(msgs), cap))
+            take, msgs = msgs[:j], msgs[j:]
+            if slot is not None:
+                self._put(slot)
+            if j == 1:
+                slot = self._single_slot(take[0], planned=0)
+                continue
+            merged = merge_chunk_messages(take)
+            self.stats["merged_slots"] += 1
+            self.stats["merged_chunks"] += j
+            self.stats["slots"] += 1
+            slot = StagedSlot(
+                kind="merged", payload=self._stage(merged["payload"]),
+                prios=self._stage(np.asarray(merged["priorities"],
+                                             np.float32)),
+                n_trans=int(merged["n_trans"]), chunks=j)
+        return slot
+
+    def _single_slot(self, msg: dict, planned: int = 1) -> StagedSlot:
+        self.stats["slots"] += 1
+        if planned:
+            with self._ahead_lock:
+                self._staged_steps += planned
+        return StagedSlot(
+            kind="single", payload=self._stage(msg["payload"]),
+            prios=self._stage(np.asarray(msg["priorities"], np.float32)),
+            n_trans=int(msg["n_trans"]), planned_steps=planned)
+
+    def _merge_cap(self, payload) -> int:
+        """Max chunks mergeable with ``payload`` as the first member: the
+        payload must be a frame chunk and the merged shapes must still
+        fit the pool's validation bounds (m*K <= capacity keeps the
+        transition scatter duplicate-free; m*Kf <= frame_capacity keeps
+        the ring write in bounds)."""
+        if not is_frame_chunk(payload):
+            return 1
+        cap = self.merge_max
+        if self.capacity is not None:
+            cap = min(cap, self.capacity // max(1, payload["action"].shape[0]))
+        if self.frame_capacity is not None:
+            cap = min(cap, self.frame_capacity
+                      // max(1, payload["frames"].shape[0]))
+        return max(1, cap)
+
+    def _put(self, slot: StagedSlot) -> None:
+        while not self._stop.is_set():
+            try:
+                self._ring.put(slot, timeout=0.1)
+                return
+            except queue_lib.Full:
+                # param publishes must not starve behind a full ring (the
+                # trainer may be deep in replay-only steps)
+                self._serve_publish()
+                continue
+
+    def _serve_publish(self) -> None:
+        with self._pub_lock:
+            req, self._pub = self._pub, None
+        if req is None:
+            return
+        version, params = req
+        host_params = jax.device_get(params)
+        self.pool.publish_params(version, host_params)
+        self.stats["publishes"] += 1

@@ -198,6 +198,29 @@ def scan_fused_steps(core, train_state, replay_state, ingest_batches,
     return train_state, replay_state, metrics
 
 
+def make_multi_ingest(core):
+    """K ingest-only steps in ONE dispatch: ``lax.scan`` over chunk/prio
+    stacks with a leading axis of K — the ingest half of
+    :func:`scan_fused_steps`, for chunks the replay-ratio cap (or warmup
+    gate) says to absorb WITHOUT training.  Each scan iteration is the
+    same ``core.ingest`` program a per-chunk dispatch runs, so the final
+    replay state is bit-identical to K sequential ``jit_ingest`` calls;
+    only the host round-trip count drops from K to 1.  Works for any core
+    exposing ``ingest`` with the shared signature (DQN
+    :class:`LearnerCore`, :class:`apex_tpu.training.aql.AQLCore`)."""
+
+    def ingest_multi(replay_state, ingest_batches, ingest_prios):
+        def body(rs, xs):
+            chunk, prios = xs
+            return core.ingest(rs, chunk, prios), ()
+
+        replay_state, _ = jax.lax.scan(
+            body, replay_state, (ingest_batches, ingest_prios))
+        return replay_state
+
+    return jax.jit(ingest_multi, donate_argnums=(0,))
+
+
 def build_learner(model, replay_capacity: int, example_obs, key: jax.Array,
                   *, alpha: float = 0.6, batch_size: int = 512,
                   lr: float = 6.25e-5, max_grad_norm: float = 40.0,

@@ -72,6 +72,19 @@ class SequenceBuilder:
         self.burn_in, self.unroll, self.n_steps = burn_in, unroll, n_steps
         self.t_total = burn_in + unroll + n_steps
         self.stride = stride or max(1, unroll // 2)
+        if pooled and self.stride > self.t_total:
+            # The pooled message packer ships each episode's union
+            # coverage [min start, max end) as ONE contiguous block sized
+            # for OVERLAPPING windows (<= t_total rows per sequence,
+            # actors/r2d2.py:pooled_sequence_message); stride > t_total
+            # leaves gaps inside that block and overflows the fixed
+            # [G*T+1] frame buffer.  Raised HERE, where the pooled layout
+            # is selected — a ValueError survives `python -O`, unlike the
+            # bare assert that used to catch this at pack time.
+            raise ValueError(
+                f"pooled sequence layout requires stride <= t_total "
+                f"(burn_in + unroll + n_steps = {self.t_total}), got "
+                f"stride={self.stride}")
         self.gamma = gamma
         # pooled: emit frame REFERENCES for the dedup sequence frame-pool
         # layout (apex_tpu/replay/seq_pool.py) — sequences share one

@@ -15,6 +15,8 @@ actually locate a bottleneck:
 from __future__ import annotations
 
 import contextlib
+import time
+from collections import deque
 from typing import Iterator
 
 import jax
@@ -58,3 +60,56 @@ def mfu(flops: float | None, calls_per_sec: float,
     if flops is None or peak_flops <= 0:
         return None
     return flops * calls_per_sec / peak_flops
+
+
+class DispatchGapTimer:
+    """Host-side dispatch-gap accounting for async-dispatch hot loops.
+
+    The gap is the wall time between one device dispatch RETURNING (the
+    jitted call handing back futures — not the computation finishing) and
+    the next dispatch being ISSUED.  Under async dispatch that gap is
+    exactly the host-side hole in the device's work feed: polling, chunk
+    stacking, H2D staging, Python bookkeeping.  A saturated learner keeps
+    it near zero; the ingest pipeline exists to move the gap's contents
+    onto a staging thread (training/ingest_pipeline.py).
+
+    Pure host timing — never touches the device, so it is safe on the hot
+    loop (unlike ``block_until_ready`` fences, which apexlint J006 flags
+    there).
+    """
+
+    def __init__(self, window: int = 512):
+        self._last_return: float | None = None
+        self._gaps: deque[float] = deque(maxlen=window)
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def about_to_dispatch(self) -> None:
+        """Call immediately before issuing a device dispatch."""
+        if self._last_return is None:
+            return
+        gap = time.perf_counter() - self._last_return
+        self._gaps.append(gap)
+        self.count += 1
+        self.total += gap
+        if gap > self.max:
+            self.max = gap
+        self._last_return = None
+
+    def dispatch_returned(self) -> None:
+        """Call immediately after the dispatch call returns."""
+        self._last_return = time.perf_counter()
+
+    def snapshot(self) -> dict:
+        """Non-mutating stats dict (ms units; p50 over the last
+        ``window`` gaps) — callers may sample it at any cadence."""
+        gaps = sorted(self._gaps)
+        p50 = gaps[len(gaps) // 2] if gaps else 0.0
+        return {
+            "dispatch_gap_ms_mean":
+                1000.0 * self.total / self.count if self.count else 0.0,
+            "dispatch_gap_ms_p50": 1000.0 * p50,
+            "dispatch_gap_ms_max": 1000.0 * self.max,
+            "dispatches": self.count,
+        }

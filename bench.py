@@ -92,6 +92,33 @@ def _e2e_seconds(platform: str) -> float:
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 240.0))
 PART1_TIMEOUT = float(os.environ.get("BENCH_PART1_TIMEOUT", 360.0))
 PART2_MARGIN = float(os.environ.get("BENCH_PART2_MARGIN", 240.0))
+PIPELINE_TIMEOUT = float(os.environ.get("BENCH_PIPELINE_TIMEOUT", 300.0))
+# wall seconds granted to train() ON TOP of the soak target so the first
+# compile of the concurrent pipeline (~20-40s on TPU) cannot eat the
+# steady-state window (VERDICT r5 weak #8: the soak used to run INSIDE
+# its own budget, leaving no compile margin)
+E2E_COMPILE_MARGIN = float(os.environ.get("BENCH_E2E_COMPILE_MARGIN", 90.0))
+
+
+def e2e_budgets(platform: str) -> tuple[float, float, float]:
+    """(soak, train_seconds, stage_seconds) for the e2e stage.
+
+    The soak (:func:`_e2e_seconds`) is the STEADY-STATE wall target; the
+    ``train()`` call gets ``soak + E2E_COMPILE_MARGIN`` so compile time
+    comes out of the margin, not the soak; and the watchdog stage budget
+    adds ``PART2_MARGIN`` on top for trainer construction, actor spawn,
+    and teardown.  Unit-tested in tests/test_bench.py — the invariant is
+    strict containment: soak < train < stage."""
+    soak = _e2e_seconds(platform)
+    train_seconds = soak + E2E_COMPILE_MARGIN
+    return soak, train_seconds, train_seconds + PART2_MARGIN
+
+
+# Relay env as the operator launched us (captured BEFORE any CPU
+# fallback overwrites it): the late re-probe must dial the ORIGINAL
+# backend, not the fallback's cpu pin.
+_RELAY_ENV_KEYS = ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+_ORIG_RELAY_ENV = {k: os.environ.get(k) for k in _RELAY_ENV_KEYS}
 
 # -- watchdog ---------------------------------------------------------------
 
@@ -149,31 +176,41 @@ _APPLY_PLATFORM_CODE = (
     "p and jax.config.update('jax_platforms', p); ")
 
 
-def probe_backend() -> str:
-    """Bring the backend up in a SUBPROCESS first: a dead relay makes
-    ``jax.devices()`` spin forever, and a subprocess can be killed where
-    the main process cannot un-hang itself.  Returns the platform the main
-    process should use ("tpu"/"cpu"/...)."""
+def _probe_in_subprocess(env: dict | None = None,
+                         timeout: float | None = None):
+    """One killable backend-init probe.  Returns ``(platform, diag)`` —
+    platform None when the init timed out or printed nothing, with the
+    tail of its output (or the timeout notice) as ``diag``."""
     code = (_APPLY_PLATFORM_CODE +
             "import jax.numpy as jnp; "
             "d = jax.devices(); "
             "(jnp.ones((256, 256), jnp.bfloat16) @ "
             "jnp.ones((256, 256), jnp.bfloat16)).block_until_ready(); "
             "print('PLATFORM=' + d[0].platform)")
+    timeout = INIT_TIMEOUT if timeout is None else timeout
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
-                           timeout=INIT_TIMEOUT)
+                           timeout=timeout, env=env)
         for line in p.stdout.splitlines():
             if line.startswith("PLATFORM="):
-                _apply_platform()   # mirror the choice the probe made
-                return line.split("=", 1)[1]
-        with _print_lock:
-            RESULT["backend_probe"] = (p.stderr or p.stdout or "")[-400:]
+                return line.split("=", 1)[1], None
+        return None, (p.stderr or p.stdout or "")[-400:]
     except subprocess.TimeoutExpired:
-        with _print_lock:
-            RESULT["backend_probe"] = (
-                f"backend init exceeded {INIT_TIMEOUT}s")
+        return None, f"backend init exceeded {timeout}s"
+
+
+def probe_backend() -> str:
+    """Bring the backend up in a SUBPROCESS first: a dead relay makes
+    ``jax.devices()`` spin forever, and a subprocess can be killed where
+    the main process cannot un-hang itself.  Returns the platform the main
+    process should use ("tpu"/"cpu"/...)."""
+    platform, diag = _probe_in_subprocess()
+    if platform is not None:
+        _apply_platform()       # mirror the choice the probe made
+        return platform
+    with _print_lock:
+        RESULT["backend_probe"] = diag
     if os.environ.get("BENCH_CPU_FALLBACK", "1") != "0":
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -182,6 +219,66 @@ def probe_backend() -> str:
     RESULT["error"] = RESULT.get("backend_probe", "backend unavailable")
     _emit_and_exit()
     raise AssertionError  # unreachable
+
+
+def _relay_child_env(environ) -> dict:
+    """The current env with the ORIGINAL relay keys restored — what a
+    late probe must dial (the CPU fallback pinned cpu into os.environ)."""
+    env = dict(environ)
+    for k, v in _ORIG_RELAY_ENV.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    return env
+
+
+def _reexec_bench() -> None:
+    """Restart the bench in a FRESH process on the original relay env: a
+    CPU-initialized jax runtime cannot be re-pointed at the TPU in place.
+    ``BENCH_NO_REPROBE`` caps the whole dance at one retry."""
+    for k, v in _ORIG_RELAY_ENV.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    os.environ["BENCH_NO_REPROBE"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def maybe_reprobe(platform: str, environ=None, probe=None, reexec=None,
+                  result: dict | None = None) -> bool:
+    """Late backend re-probe (VERDICT r5 weak #1 / next-round item 2).
+
+    Rounds 4 and 5 lost their only TPU shot to a single 240s probe at
+    t=0; a relay that warms up DURING the bench still yielded a full-CPU
+    round.  Between part 1 and the e2e stage, when (and only when) the
+    initial probe FELL BACK — never when the operator explicitly chose a
+    platform — re-probe once on the original relay env with the same hard
+    subprocess timeout.  If the TPU answers, re-exec the bench so a fresh
+    process runs every stage on silicon (strictly better than the CPU
+    numbers it discards); otherwise record the attempt and continue.
+
+    ``probe``/``reexec``/``environ``/``result`` are test seams
+    (tests/test_bench.py fakes the probe both ways).  Returns True when a
+    re-exec was requested."""
+    environ = os.environ if environ is None else environ
+    result = RESULT if result is None else result
+    if platform == "tpu" or environ.get("BENCH_NO_REPROBE") == "1":
+        return False
+    if "backend_probe" not in result:
+        return False            # no fallback happened: cpu was the ask
+    if probe is None:
+        def probe():
+            return _probe_in_subprocess(_relay_child_env(environ))[0]
+    got = probe()
+    result["late_reprobe"] = got or "no-answer"
+    if got != "tpu":
+        return False
+    (reexec or _reexec_bench)()
+    return True
 
 
 # -- final stage: pallas kernel probe ---------------------------------------
@@ -344,13 +441,205 @@ def bench_fused_step() -> dict:
     return out
 
 
+# -- part 1b: async ingest pipeline on vs off -------------------------------
+
+def bench_ingest_pipeline() -> dict:
+    """The per-ingest framepool hot loop through the REAL concurrent
+    trainer, pipeline ON vs OFF, same pre-recorded chunk stream.
+
+    The stream arrives PICKLED (the decode cost every real data plane
+    pays — mp.Queue pickle or socket recv) through an in-process pool, in
+    the ingest-dominant regime a production Ape-X learner actually runs
+    (train_ratio caps steps well below chunk supply, so most chunks are
+    absorbed ingest-only).  Serial pays decode + H2D + one dispatch per
+    chunk inline on the hot loop; the pipeline moves decode/staging onto
+    the background thread and coalesces ingest-only chunks into merged
+    payloads (training/ingest_pipeline.py).  Both lanes run the same
+    step/transition quantum, so the transitions-per-second ratio is the
+    pipeline's honest speedup on this machine — recorded either way,
+    with the dispatch-gap stats that locate where the host time went.
+
+    Small MLP geometry on purpose: the stage measures the INGEST path
+    (dispatch count, decode, staging), not MXU throughput — part 1 and
+    the e2e stage own those.
+    """
+    import pickle
+
+    import numpy as np
+
+    from apex_tpu.config import (ActorConfig, ApexConfig, EnvConfig,
+                                 LearnerConfig, ReplayConfig)
+    from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+    from apex_tpu.training.apex import ApexTrainer
+
+    chunk_k = int(os.environ.get("BENCH_PIPE_CHUNK", 128))
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", 128))
+    ratio = float(os.environ.get("BENCH_PIPE_RATIO", 0.015625))
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", 24))
+    reps = int(os.environ.get("BENCH_PIPE_REPS", 2))
+    warm_steps = 4
+    # chunk supply sized so neither lane ever runs dry: warmup fill plus
+    # steps/ratio budget over all reps, with 2x headroom.  A small set of
+    # UNIQUE chunks is recycled to keep stream generation off the stage
+    # budget — every poll still pays the full decode (fresh pickle.loads
+    # per message), which is what the lanes measure.
+    n_chunks = int(2 * (1024 + (warm_steps + reps * steps) * batch / ratio)
+                   / chunk_k) + 8
+    n_unique = min(n_chunks, 96)
+
+    rng = np.random.default_rng(0)
+    builder = FrameChunkBuilder(3, 0.99, 1, (4,), chunk_transitions=chunk_k,
+                                frame_dtype=np.float32)
+    unique: list[bytes] = []
+    while len(unique) < n_unique:
+        builder.begin_episode(rng.normal(size=4).astype(np.float32))
+        ep_len = int(rng.integers(20, 200))
+        for t in range(ep_len):
+            builder.add_step(int(rng.integers(0, 2)), float(rng.normal()),
+                             rng.normal(size=2).astype(np.float32),
+                             rng.normal(size=4).astype(np.float32),
+                             terminated=t == ep_len - 1, truncated=False)
+        for chunk in builder.poll():
+            prios = chunk.pop("priorities")
+            unique.append(pickle.dumps(
+                {"payload": chunk, "priorities": prios,
+                 "n_trans": int(chunk["n_trans"])},
+                protocol=pickle.HIGHEST_PROTOCOL))
+    unique = unique[:n_unique]
+    blobs = [unique[i % n_unique] for i in range(n_chunks)]
+
+    class _PickledStreamPool:
+        """In-process stand-in for the worker data plane: chunks decode
+        (unpickle) at poll time — on the hot loop serially, on the
+        staging thread pipelined; params pay the publish serialization
+        either way."""
+
+        def __init__(self, stream):
+            self._stream = list(stream)
+            self.procs = []
+
+        def start(self):
+            pass
+
+        def cleanup(self):
+            pass
+
+        def publish_params(self, version, params):
+            pickle.dumps(params, protocol=pickle.HIGHEST_PROTOCOL)
+
+        def poll_stats(self):
+            return []
+
+        def poll_chunks(self, max_chunks, timeout=0.0):
+            out = []
+            while self._stream and len(out) < max_chunks:
+                out.append(pickle.loads(self._stream.pop(0)))
+            return out
+
+    def warm_shapes(trainer, pipeline_on: bool) -> None:
+        """Compile every dispatch shape the lane will use OUTSIDE the
+        timed window, on throwaway copies of the donated states (the
+        compile cost is a once-per-process constant, not the per-step
+        throughput this stage measures)."""
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.training.ingest_pipeline import merge_chunk_messages
+
+        def cp(tree):
+            return jax.tree.map(jnp.copy, tree)
+
+        key_f, key_t = jax.random.split(jax.random.key(999))
+        beta = jnp.float32(0.4)
+        merge_max = trainer.cfg.learner.pipeline_merge
+        msgs = [pickle.loads(b) for b in blobs[:merge_max]]
+
+        def forms(msg):
+            payload = msg["payload"]
+            prios = np.asarray(msg["priorities"], np.float32)
+            if pipeline_on:      # staged slots arrive as device arrays
+                return jax.device_put(payload), jax.device_put(prios)
+            return payload, jnp.asarray(prios)
+
+        pay, pr = forms(msgs[0])
+        jax.block_until_ready(
+            trainer._ingest(cp(trainer.replay_state), pay, pr))
+        out = trainer._fused(cp(trainer.train_state),
+                             cp(trainer.replay_state), pay, pr, key_f, beta)
+        jax.block_until_ready(out[2]["loss"])
+        out = trainer._train(cp(trainer.train_state),
+                             cp(trainer.replay_state), key_t, beta)
+        jax.block_until_ready(out[2]["loss"])
+        if pipeline_on:
+            w, outs = 2, []
+            while w <= merge_max and w <= len(msgs):
+                mpay, mpr = forms(merge_chunk_messages(msgs[:w]))
+                outs.append(trainer._ingest(cp(trainer.replay_state),
+                                            mpay, mpr))
+                w *= 2
+            jax.block_until_ready(outs)
+
+    def lane(pipeline_on: bool) -> dict:
+        cfg = ApexConfig(
+            env=EnvConfig(env_id="ApexCartPole-v0", frame_stack=1,
+                          clip_rewards=False, episodic_life=False),
+            replay=ReplayConfig(capacity=2 ** 13, warmup=1024),
+            learner=LearnerConfig(batch_size=batch, ingest_chunk=chunk_k,
+                                  compute_dtype="float32",
+                                  target_update_interval=500,
+                                  ingest_pipeline=pipeline_on,
+                                  pipeline_merge=32),
+            actor=ActorConfig(n_actors=1, send_interval=chunk_k),
+        )
+        trainer = ApexTrainer(cfg, pool=_PickledStreamPool(blobs),
+                              publish_min_seconds=1.0, train_ratio=ratio,
+                              respawn_workers=False)
+        warm_shapes(trainer, pipeline_on)
+        # warm call: the loop's own paths (publish copies, rate counters)
+        trainer.train(total_steps=warm_steps, max_seconds=120,
+                      log_every=10 ** 9)
+        runs = []
+        for _ in range(reps):        # best-of-reps damps 1-core scheduler
+            ingested0 = trainer.ingested         # noise in short windows
+            steps0 = trainer.steps_rate.total
+            t0 = time.perf_counter()
+            trainer.train(total_steps=steps, max_seconds=120,
+                          log_every=10 ** 9)
+            dt = time.perf_counter() - t0
+            runs.append({
+                "trans_per_sec":
+                    round((trainer.ingested - ingested0) / dt, 1),
+                "steps_per_sec":
+                    round((trainer.steps_rate.total - steps0) / dt, 2),
+                "seconds": round(dt, 2),
+                "transitions": trainer.ingested - ingested0,
+                "dispatch_gap": trainer._dispatch_gap.snapshot(),
+            })
+        out = max(runs, key=lambda r: r["trans_per_sec"])
+        out["reps"] = [r["trans_per_sec"] for r in runs]
+        if pipeline_on:
+            out["pipeline"] = trainer._pipeline_last_stats
+        return out
+
+    serial = lane(False)
+    pipelined = lane(True)
+    speedup = (pipelined["trans_per_sec"] / serial["trans_per_sec"]
+               if serial["trans_per_sec"] else None)
+    return {"geometry": f"cartpole-mlp_b{batch}_k{chunk_k}",
+            "train_ratio": ratio, "steps": steps,
+            "serial": serial, "pipelined": pipelined,
+            "speedup": None if speedup is None else round(speedup, 3)}
+
+
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
 def bench_end_to_end(e2e_seconds: float) -> dict:
     """The real ApexTrainer pipeline — vectorized actor processes feeding
     the fused learner through the shm chunk plane — on the PIXEL env
     ``ApexCatch-v0`` (84x84x4 uint8, flagship geometry) for
-    ``e2e_seconds`` (a >=300s soak on TPU, see :func:`_e2e_seconds`)."""
+    ``e2e_seconds`` wall (the soak target plus the compile margin — see
+    :func:`e2e_budgets`).  Runs with the async ingest pipeline at its
+    config default, so the number measured is the shipping hot loop."""
     from apex_tpu.config import (ActorConfig, ApexConfig, EnvConfig,
                                  LearnerConfig, ReplayConfig)
     from apex_tpu.training.apex import ApexTrainer
@@ -444,6 +733,9 @@ def bench_end_to_end(e2e_seconds: float) -> dict:
             "data_plane": data_plane,
             "scan_steps": scan_steps,
             "scan_dispatches": trainer.scan_dispatches,
+            "ingest_pipeline": trainer._pipeline_last_stats,
+            "dispatch_gap": (trainer._dispatch_gap.snapshot()
+                             if trainer._dispatch_gap is not None else None),
             "seconds": round(dt, 1)}
 
 
@@ -493,14 +785,31 @@ def main() -> None:
     print(f"[bench] part 1 done: {json.dumps(RESULT)}",
           file=sys.stderr, flush=True)
 
-    e2e_seconds = _e2e_seconds(platform)
-    _arm("e2e", e2e_seconds + PART2_MARGIN)
+    if os.environ.get("BENCH_SKIP_PIPELINE", "0") != "1":
+        _arm("ingest_pipeline", PIPELINE_TIMEOUT)
+        try:
+            pipe = bench_ingest_pipeline()
+        except Exception as exc:   # the headline metric survives regardless
+            pipe = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["ingest_pipeline"] = pipe
+
+    # Late backend re-probe between part 1 and the e2e soak: a relay that
+    # warmed up after the t=0 probe re-execs the bench onto the TPU
+    # instead of burning the round on CPU fallback numbers.
+    _arm("late_reprobe", INIT_TIMEOUT + 30)
+    maybe_reprobe(platform)
+
+    soak, e2e_train_seconds, e2e_stage_seconds = e2e_budgets(platform)
+    _arm("e2e", e2e_stage_seconds)
     try:
-        e2e = bench_end_to_end(e2e_seconds)
+        e2e = bench_end_to_end(e2e_train_seconds)
     except Exception as exc:      # never lose the primary metric
         e2e = {"error": f"{type(exc).__name__}: {exc}"}
     with _print_lock:
         RESULT["e2e"] = e2e
+        RESULT["e2e_budgets"] = {"soak": soak, "train": e2e_train_seconds,
+                                 "stage": e2e_stage_seconds}
 
     if (platform == "tpu" and not operator_forced
             and os.environ.get("BENCH_SKIP_PALLAS", "0") != "1"):

@@ -348,6 +348,78 @@ def test_j005_silent_outside_loop():
         """, "J005")
 
 
+# -- J006: host sync inside a hot loop --------------------------------------
+
+def test_j006_fires_on_device_get_in_loop():
+    assert fires("""
+        import jax
+        def train_loop(pool, ts):
+            while True:
+                step(ts)
+                params = jax.device_get(ts.params)
+                pool.publish_params(1, params)
+        """, "J006")
+
+
+def test_j006_fires_on_block_until_ready_method_in_loop():
+    assert fires("""
+        def drain(chunks, ingest, rs):
+            for chunk in chunks:
+                rs = ingest(rs, chunk)
+                rs.pos.block_until_ready()
+            return rs
+        """, "J006")
+
+
+def test_j006_silent_outside_loop():
+    assert not fires("""
+        import jax
+        def publish(pool, ts):
+            params = jax.device_get(ts.params)
+            pool.publish_params(1, params)
+        """, "J006")
+
+
+def test_j006_silent_in_timing_harness():
+    """A loop that reads the clock is a measurement harness — timing a
+    device fence is the one legitimate hot-loop sync (bench.py's rep
+    loops)."""
+    assert not fires("""
+        import time, jax
+        def measure(fn, ts, reps):
+            rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = fn(ts)
+                jax.block_until_ready(out)
+                rates.append(time.perf_counter() - t0)
+            return rates
+        """, "J006")
+
+
+def test_j006_silent_under_trace_scope():
+    assert not fires("""
+        import jax
+        from apex_tpu.utils.profiling import trace
+        def profile(fn, ts, xs):
+            with trace("/tmp/prof"):
+                for x in xs:
+                    jax.block_until_ready(fn(ts, x))
+        """, "J006")
+
+
+def test_j006_silent_in_jitted_scope():
+    """Inside jit it's J002's territory, not a hot-loop finding."""
+    assert not fires("""
+        import jax
+        @jax.jit
+        def step(xs):
+            for x in xs:
+                y = jax.device_get(x)
+            return y
+        """, "J006")
+
+
 # -- C001: process start after a live thread --------------------------------
 
 def test_c001_fires_on_fork_after_thread():

@@ -109,6 +109,34 @@ def test_provisioning_is_pinned_and_idempotent():
     assert "build-essential" in text, "native shm ring needs a compiler"
 
 
+def test_pyproject_dependencies_pinned_in_provision():
+    """Closes the ``--no-deps`` drift hole (ADVICE): every
+    ``[project].dependencies`` name from pyproject.toml must appear in
+    provision.sh's pip pin list, or a new runtime dep would install in
+    dev environments but silently be absent from every baked fleet
+    image."""
+    pyproject = DEPLOY.parent / "pyproject.toml"
+    try:
+        import tomllib
+        deps = tomllib.loads(pyproject.read_text())["project"]["dependencies"]
+    except ModuleNotFoundError:                      # pre-3.11 fallback
+        m = re.search(r"dependencies\s*=\s*\[(.*?)\]",
+                      pyproject.read_text(), re.DOTALL)
+        assert m, "no [project].dependencies in pyproject.toml"
+        deps = re.findall(r'"([^"]+)"', m.group(1))
+    assert deps, "pyproject declares no dependencies?"
+
+    text = (DEPLOY / "provision.sh").read_text()
+    pin_lines = [ln for ln in text.splitlines() if '"' in ln
+                 and ("pip install" in ln or ln.strip().startswith('"'))]
+    pins = " ".join(pin_lines)
+    for dep in deps:
+        name = re.split(r"[<>=!~;\[\s]", dep.strip(), 1)[0]
+        assert re.search(rf'"{re.escape(name)}(\[\w+\])?[=">]', pins), \
+            f"pyproject dependency {name!r} missing from provision.sh's " \
+            f"pip pin list — baked images would ship without it"
+
+
 def test_role_scripts_use_baked_env():
     """Every role bootstrap must run through the provisioned interpreter
     (baked image or first-boot fallback) — an unpinned system python is

@@ -227,6 +227,20 @@ def test_sequence_builder_segmentation_and_padding():
     assert b.drain() == []
 
 
+def test_pooled_builder_rejects_stride_beyond_window():
+    """The pooled message packer's union-coverage packing assumes
+    OVERLAPPING windows (stride <= t_total); the guard is a ValueError at
+    layout selection — it must survive ``python -O``, where the bare
+    pack-time assert it replaced would vanish (ADVICE)."""
+    burn, unroll, n = 2, 4, 2          # t_total = 8
+    with pytest.raises(ValueError, match="stride <= t_total"):
+        SequenceBuilder(burn, unroll, n, gamma=0.9, stride=9, pooled=True)
+    # boundary and stacked layouts stay legal: stride == t_total packs
+    # gap-free, and the stacked layout copies windows (no union packing)
+    SequenceBuilder(burn, unroll, n, gamma=0.9, stride=8, pooled=True)
+    SequenceBuilder(burn, unroll, n, gamma=0.9, stride=9, pooled=False)
+
+
 def test_sequence_builder_emits_nothing_for_empty_episode():
     b = SequenceBuilder(2, 4, 2, gamma=0.9)
     b.end_episode()
