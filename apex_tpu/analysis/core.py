@@ -14,6 +14,8 @@ as jitted scope when:
 * it is decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
 * its name is passed to a ``jax.jit(...)`` call anywhere in the module
   (``jax.jit(self.train_step, ...)`` marks ``train_step``);
+* its name is passed to a ``shard_map(...)`` call (any alias spelling) —
+  the mapped body always ends up inside the jitted program;
 * it is returned by a ``make_*_fn`` factory (the repo's policy-fn
   convention — call sites jit the factory's result in other modules);
 * it is (transitively) called by name from another jitted function in the
@@ -110,6 +112,19 @@ def is_jit_expr(node: ast.AST) -> bool:
     return False
 
 
+def is_shard_map_expr(node: ast.AST) -> bool:
+    """``shard_map`` / ``jax.shard_map`` / ``shard_map_compat`` (the
+    repo's version wrapper) — a function handed to any of these runs as
+    the per-chip body of a compiled program, i.e. jitted scope."""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    else:
+        return False
+    return name == "shard_map" or name.startswith("shard_map_")
+
+
 def call_name(node: ast.Call) -> str | None:
     """Bare name of the callee: ``g(...)`` -> g, ``x.g(...)`` -> g."""
     if isinstance(node.func, ast.Name):
@@ -192,8 +207,9 @@ class ModuleContext:
             if any(is_jit_expr(d) for d in fn.decorator_list):
                 jitted.add(fn)
         for node in ast.walk(self.tree):
-            if (isinstance(node, ast.Call) and is_jit_expr(node.func)
-                    and node.args):
+            if (isinstance(node, ast.Call) and node.args
+                    and (is_jit_expr(node.func)
+                         or is_shard_map_expr(node.func))):
                 tgt = node.args[0]
                 if isinstance(tgt, ast.Name):
                     seeds.add(tgt.id)

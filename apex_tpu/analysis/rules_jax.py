@@ -368,6 +368,9 @@ def _scan_function_keys(rule: Rule, ctx: ModuleContext, fn) -> list[Finding]:
     def visit_call(node: ast.Call) -> None:
         if _is_key_source(node):
             return                # split/fold_in refresh, not a consumption
+        if call_name(node) in ("getattr", "hasattr", "isinstance", "len",
+                               "type", "id"):
+            return                # introspection reads no PRNG material
         for arg in list(node.args) + [k.value for k in node.keywords]:
             for name in names_in(arg):
                 if name in keys and not comp_bound(node, name):
@@ -544,6 +547,44 @@ class HostSyncInHotLoop(Rule):
                 f"iteration stalls async dispatch; stage it off the hot "
                 f"loop (training/ingest_pipeline) or wrap the "
                 f"measurement in a profiling trace scope"))
+        return out
+
+
+# -- J007 -------------------------------------------------------------------
+
+
+@register
+class DevicePutInJit(Rule):
+    id = "J007"
+    name = "device-put-in-jit"
+    description = ("jax.device_put inside jitted/shard_map scope: a "
+                   "placement request inside compiled code is at best a "
+                   "redundant copy and at worst a per-call transfer — "
+                   "stage operands before the dispatch (the ingest "
+                   "pipeline's staging thread exists for exactly this)")
+
+    _PUT_ATTRS = {"device_put", "device_put_sharded",
+                  "device_put_replicated"}
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in self._PUT_ATTRS
+                    and _attr_root(f) in _JNP_ALIASES):
+                continue
+            fn = ctx.in_jitted_scope(node)
+            if fn is None:
+                continue
+            out.append(ctx.finding(
+                self, node,
+                f"jax.{f.attr} inside jitted scope '{fn.name}' — "
+                f"placement belongs before the jit/shard_map boundary; "
+                f"stage the operand host-side "
+                f"(training/ingest_pipeline.py staging thread)"))
         return out
 
 

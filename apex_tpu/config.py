@@ -88,17 +88,23 @@ class LearnerConfig:
     # device_puts the next dispatch's data into a bounded on-device ring
     # while the current fused step runs — host decode, H2D staging, and
     # device compute overlap instead of serializing.  Order-preserving and
-    # numerics-neutral (bit-parity pinned in tests/test_ingest_pipeline.py).
-    # Single-shard concurrent trainers only; dp>1 meshes and the
-    # single-process drivers quietly ignore it.  False = the serial loop.
+    # numerics-neutral (bit-parity pinned in tests/test_ingest_pipeline.py
+    # and, for dp>1, tests/test_sharded_pipeline.py).  Covers every
+    # concurrent trainer: single-shard learners stage chunk-granular
+    # slots; dp>1 meshes stage whole round-robin groups (per-shard merged
+    # when ingest-only, NamedSharding device_put over the dp axis) with
+    # per-chip PRNG keys pre-split + pre-placed off the hot loop.  The
+    # single-process drivers quietly ignore it.  False = the serial
+    # drain (kept reachable for A/B).
     ingest_pipeline: bool = True
     # Staged-slot ring depth.  2 = classic double buffering (the next
     # dispatch's data is in HBM while the current one runs); deeper rings
     # buy nothing but memory and backpressure latency.
     pipeline_depth: int = 2
-    # Max frame chunks coalesced into ONE ingest payload when the learner
-    # is not train-eligible (warmup fill / replay-ratio cap) — each merge
-    # of m chunks turns m dispatches + m H2D copies into one.
+    # Max frame chunks (dp>1: round-robin groups) coalesced into ONE
+    # ingest payload when the learner is not train-eligible (warmup fill /
+    # replay-ratio cap) — each merge of m turns m dispatches + m H2D
+    # copies into one.
     pipeline_merge: int = 8
 
 

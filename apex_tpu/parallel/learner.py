@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu.parallel.mesh import shard_map_compat
 from apex_tpu.replay.device import ReplayState
 from apex_tpu.training.learner import LearnerCore
 from apex_tpu.training.state import TrainState
@@ -101,11 +102,22 @@ class ShardedLearner:
 
     # -- the sharded fused step --------------------------------------------
 
+    def _per_chip_batch(self) -> int:
+        """batch/dp, validated loudly (a ``ValueError`` survives
+        ``python -O`` where an assert would vanish into a silent
+        shape mismatch inside the shard_map trace)."""
+        per_chip, rem = divmod(self.core.batch_size, self.n_dp)
+        if rem:
+            raise ValueError(
+                f"learner.batch_size={self.core.batch_size} must be "
+                f"divisible by the dp axis (dp={self.n_dp}, from "
+                f"learner.mesh_shape) — raise batch_size or shrink "
+                f"the mesh")
+        return per_chip
+
     def make_fused_step(self):
         core = self.core
-        per_chip_batch = core.batch_size // self.n_dp
-        assert per_chip_batch * self.n_dp == core.batch_size, \
-            "batch_size must divide the dp axis"
+        per_chip_batch = self._per_chip_batch()
 
         def per_chip(ts: TrainState, rs: ReplayState, ingest: Any,
                      prios: jax.Array, key: jax.Array, beta: jax.Array):
@@ -130,7 +142,7 @@ class ShardedLearner:
 
         shard = P("dp")
         repl = P()
-        mapped = jax.shard_map(
+        mapped = shard_map_compat(
             per_chip, mesh=self.mesh,
             in_specs=(repl, shard, shard, shard, shard, repl),
             out_specs=(repl, shard, repl),
@@ -141,8 +153,7 @@ class ShardedLearner:
         """Sample/update only (no ingest) — the learner's catch-up step when
         no chunk is pending."""
         core = self.core
-        per_chip_batch = core.batch_size // self.n_dp
-        assert per_chip_batch * self.n_dp == core.batch_size
+        per_chip_batch = self._per_chip_batch()
 
         def per_chip(ts: TrainState, rs: ReplayState, key: jax.Array,
                      beta: jax.Array):
@@ -160,7 +171,7 @@ class ShardedLearner:
             rs = jax.tree.map(lambda x: x[None], rs)
             return new_ts, rs, metrics
 
-        mapped = jax.shard_map(
+        mapped = shard_map_compat(
             per_chip, mesh=self.mesh,
             in_specs=(P(), P("dp"), P("dp"), P()),
             out_specs=(P(), P("dp"), P()),
@@ -177,7 +188,7 @@ class ShardedLearner:
             rs = core.replay.add(rs, ingest, prios[0])
             return jax.tree.map(lambda x: x[None], rs)
 
-        mapped = jax.shard_map(
+        mapped = shard_map_compat(
             per_chip, mesh=self.mesh,
             in_specs=(P("dp"), P("dp"), P("dp")),
             out_specs=P("dp"),
@@ -196,10 +207,24 @@ class ShardedLearner:
 
         def split(x):
             k = x.shape[0]
-            assert k % n == 0, f"ingest chunk {k} must divide dp={n}"
+            if k % n != 0:
+                raise ValueError(
+                    f"ingest chunk of {k} transitions must be divisible "
+                    f"by the dp axis (dp={n}, from learner.mesh_shape) — "
+                    f"align actor.send_interval / learner.ingest_chunk "
+                    f"with the mesh")
             return x.reshape(k // n, n, *x.shape[1:]).swapaxes(0, 1)
 
         return ({k: split(v) for k, v in batch.items()}, split(prios))
+
+    def shard_put(self, tree_obj: Any) -> Any:
+        """Place a host tree whose leading axis is the dp shard axis into
+        device memory, one shard slice per chip (NamedSharding over dp).
+        The ingest pipeline's staging thread uses this so the sharded
+        dispatch finds its operands already resident (H2D overlaps the
+        previous step's compute)."""
+        sharding = NamedSharding(self.mesh, P("dp"))
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), tree_obj)
 
     def device_keys(self, key: jax.Array) -> jax.Array:
         """One PRNG key per chip as raw key data (uint32), sharded over dp.

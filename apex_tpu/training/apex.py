@@ -88,9 +88,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
     scan_steps = 1
     scan_dispatches = 0      # K-step dispatches taken (observability)
     # async ingest pipeline (training/ingest_pipeline.py): live only
-    # inside train() when config.learner.ingest_pipeline and the learner
-    # is single-shard; _ingest_multi is the scan-of-ingests dispatch for
-    # slots the replay-ratio cap says to absorb without training
+    # inside train() when config.learner.ingest_pipeline — single-shard
+    # (chunk-granular) and dp>1 (round-robin-group-granular, pre-placed
+    # per-chip keys) alike; _ingest_multi is the scan-of-ingests dispatch
+    # for slots the replay-ratio cap says to absorb without training
     _pipeline = None
     _pipeline_base = 0       # self.ingested when the pipeline started
     _ingest_multi = None
@@ -162,11 +163,20 @@ class ConcurrentTrainer(CheckpointableTrainer):
         train = sl.make_train_step()
         ingest = sl.make_ingest()
 
+        def _keys(key):
+            # pre-split + pre-placed per-chip keys (the pipeline's
+            # KeyPrefetcher hands raw uint32 key data already sharded
+            # over the mesh) pass straight through; a raw chain key pays
+            # the serial per-dispatch split + sharded put
+            if getattr(key, "dtype", None) == jnp.uint32:
+                return key
+            return sl.device_keys(key)
+
         def _fused(ts, rs, payload, prios, key, beta):
-            return fused(ts, rs, payload, prios, sl.device_keys(key), beta)
+            return fused(ts, rs, payload, prios, _keys(key), beta)
 
         def _train(ts, rs, key, beta):
-            return train(ts, rs, sl.device_keys(key), beta)
+            return train(ts, rs, _keys(key), beta)
 
         self._fused, self._train, self._ingest = _fused, _train, ingest
 
@@ -185,6 +195,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
         pipeline = None
         if self._use_pipeline():
             from apex_tpu.training.ingest_pipeline import IngestPipeline
+            sharded = getattr(self, "sharded", None)
             pipeline = IngestPipeline(
                 pool,
                 depth=getattr(cfg.learner, "pipeline_depth", 2),
@@ -193,7 +204,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 merge_max=getattr(cfg.learner, "pipeline_merge", 8),
                 state_fn=self._pipeline_state,
                 capacity=getattr(self.replay, "capacity", None),
-                frame_capacity=getattr(self.replay, "f_capacity", None))
+                frame_capacity=getattr(self.replay, "f_capacity", None),
+                # dp>1: group-granular staging + the key prefetcher takes
+                # over the dispatch key chain (seeded with self.key;
+                # _dispatch_key writes the advanced chain state back)
+                sharded=sharded,
+                key=self.key if sharded is not None else None)
             self._pipeline = pipeline
             self._pipeline_base = self.ingested
         try:
@@ -276,7 +292,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
                             metrics = m
                 if not got_data and warm \
                         and self.steps_rate.total < budget:
-                    self.key, k = jax.random.split(self.key)
+                    k = self._dispatch_key()
                     gap.about_to_dispatch()
                     self.train_state, self.replay_state, metrics = \
                         self._train(self.train_state, self.replay_state, k,
@@ -374,11 +390,29 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # -- async ingest pipeline (training/ingest_pipeline.py) ---------------
 
     def _use_pipeline(self) -> bool:
-        """Pipeline staging applies to single-shard concurrent learners;
-        the dp>1 plan keeps the serial drain (whole-chunk round-robin
-        through ChunkAggregator is its own staging discipline)."""
-        return bool(getattr(self.cfg.learner, "ingest_pipeline", False)
-                    and getattr(self, "n_dp", 1) == 1)
+        """Pipeline staging applies to every concurrent learner,
+        single-shard and dp>1 alike: the sharded plan stages whole
+        round-robin groups (ChunkAggregator-stacked, per-shard-merged
+        when ingest-only) plus pre-split per-chip keys ahead of the
+        sharded dispatch.  ``ingest_pipeline=False`` keeps the serial
+        drain for A/B."""
+        return bool(getattr(self.cfg.learner, "ingest_pipeline", False))
+
+    def _dispatch_key(self):
+        """One dispatch's PRNG key, advancing the key chain exactly as
+        the serial loop's ``self.key, k = split(self.key)`` does.  While
+        a sharded pipelined run is live, the pipeline's KeyPrefetcher
+        owns the chain: it hands back keys already split per chip and
+        placed over the mesh, plus the chain state the inline split
+        would have left in ``self.key`` (so mid-train checkpoints and
+        post-train ``self.key`` stay bit-identical to a serial run of
+        the same dispatch count)."""
+        pipe = self._pipeline
+        if pipe is not None and pipe.keys is not None:
+            placed, self.key = pipe.keys.take()
+            return placed
+        self.key, k = jax.random.split(self.key)
+        return k
 
     def _pipeline_state(self):
         """Counter snapshot for the staging thread's grouping decisions.
@@ -434,7 +468,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 betas = np.asarray(
                     [self._beta(self.ingested + int(o)) for o in offsets],
                     np.float32)
-                self.key, k = jax.random.split(self.key)
+                # scan slots exist only on the single-shard plan, so the
+                # key is a raw chain key here — never prefetcher output
+                k = self._dispatch_key()
                 gap.about_to_dispatch()
                 self.train_state, self.replay_state, mm = \
                     self._multi(self.train_state, self.replay_state,
@@ -454,7 +490,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 gap.dispatch_returned()
         elif slot.kind == "single" and warm \
                 and self.steps_rate.total < budget:
-            self.key, k = jax.random.split(self.key)
+            k = self._dispatch_key()
             gap.about_to_dispatch()
             self.train_state, self.replay_state, metrics = \
                 self._fused(self.train_state, self.replay_state,
@@ -495,7 +531,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
             betas = np.asarray(
                 [self._beta(self.ingested + int(o))
                  for o in offsets], np.float32)
-            self.key, k = jax.random.split(self.key)
+            k = self._dispatch_key()
             gap.about_to_dispatch()
             self.train_state, self.replay_state, mm = \
                 self._multi(self.train_state, self.replay_state,
@@ -518,7 +554,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # so the documented ``train_ratio`` really is the ceiling
             # (ingesting raises the budget for later steps).
             if warm and self.steps_rate.total < budget:
-                self.key, k = jax.random.split(self.key)
+                k = self._dispatch_key()
                 gap.about_to_dispatch()
                 self.train_state, self.replay_state, metrics = \
                     self._fused(self.train_state, self.replay_state,

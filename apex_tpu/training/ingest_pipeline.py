@@ -51,6 +51,27 @@ on-device param copy and the thread performs the blocking
 ``jax.device_get`` + serialization that used to drain the whole device
 pipeline from inside the hot loop (apexlint J006 now guards against that
 pattern coming back).
+
+Sharded (dp>1) plan: the same staging stage drives the multi-chip
+learner.  ``ChunkAggregator`` already assembles whole ROUND-ROBIN groups
+(``n_dp`` worker chunks stacked on a leading dp axis — chunk i of a group
+lands on chip i), so each polled message is one group and the pipeline
+stages group-granular slots:
+
+* train-eligible groups stage as ``"single"`` slots whose payload is
+  ``device_put`` with a ``NamedSharding`` over the dp axis (H2D lands
+  each shard's slice on its chip ahead of the dispatch);
+* ingest-only groups merge PER SHARD via :func:`merge_group_messages`:
+  shard s's m chunks compact exactly as the single-shard merge does
+  (frame refs rebased by cumulative real-frame offsets, ``epoch_off``
+  carried), then the n_dp merged payloads restack on the dp axis —
+  shards are independent replays, so bit-parity reduces to the
+  single-shard merge contract per shard;
+* per-chip PRNG keys are PRE-SPLIT and PRE-PLACED by a
+  :class:`KeyPrefetcher` that owns the trainer's dispatch key chain: the
+  serial loop pays a host ``jax.random.split`` + sharded ``device_put``
+  inside every dispatch (``ShardedLearner.device_keys``); the prefetcher
+  generates the exact same chain ahead of time on the staging side.
 """
 
 from __future__ import annotations
@@ -58,6 +79,7 @@ from __future__ import annotations
 import queue as queue_lib
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 
 import jax
@@ -153,6 +175,83 @@ def merge_chunk_messages(msgs: list[dict]) -> dict:
     return {"payload": payload, "priorities": prios, "n_trans": tot_tr}
 
 
+def merge_group_messages(msgs: list[dict], n_dp: int) -> dict:
+    """Merge m stacked round-robin GROUP messages into ONE sharded ingest
+    message.
+
+    Each input message carries ``n_dp`` chunks on a leading dp axis
+    (``ChunkAggregator``'s stacking).  Shard s receives chunk s of every
+    group, in group order — exactly the stream it would ingest group by
+    group — so its m chunks merge with :func:`merge_chunk_messages`
+    (refs rebased, ``epoch_off`` carried) and the n_dp merged payloads
+    restack on the dp axis.  Shards own independent replays, so the
+    sharded bit-parity contract ``add(merge(g1..gm)) == add(g1); ...;
+    add(gm)`` holds per shard by the single-shard merge contract
+    (tests/test_sharded_pipeline.py pins it through the real pool).
+    """
+    if len(msgs) == 1:
+        return msgs[0]
+    per_shard = []
+    for s in range(n_dp):
+        shard_msgs = [
+            {"payload": jax.tree.map(lambda x: x[s], m["payload"]),
+             "priorities": np.asarray(m["priorities"])[s],
+             "n_trans": int(np.asarray(m["payload"]["n_trans"])[s])}
+            for m in msgs]
+        per_shard.append(merge_chunk_messages(shard_msgs))
+    payload = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *[p["payload"] for p in per_shard])
+    prios = np.stack([np.asarray(p["priorities"], np.float32)
+                      for p in per_shard])
+    return {"payload": payload, "priorities": prios,
+            "n_trans": sum(int(p["n_trans"]) for p in per_shard)}
+
+
+class KeyPrefetcher:
+    """Pre-split, pre-placed per-chip PRNG keys for the sharded plan.
+
+    Owns the trainer's dispatch key chain while the pipeline is live.
+    Entry i is ``(device_keys(k_i), chain_{i+1})`` where ``chain_{i+1},
+    k_i = split(chain_i)`` — the EXACT per-dispatch sequence the serial
+    loop produces with ``self.key, k = split(self.key)`` followed by
+    ``ShardedLearner.device_keys(k)``.  The consumer pops entries in
+    dispatch order and assigns the returned chain state back to its
+    ``self.key``, so pipelined runs consume bit-identical keys to serial
+    runs of the same dispatch count AND leave the trainer's key where a
+    serial run would (checkpoints taken mid-train stay exact).
+
+    The staging thread refills between polls; an empty queue (startup,
+    key-hungry burst) generates synchronously under the same lock, so
+    the chain never forks.
+    """
+
+    def __init__(self, sharded, key, depth: int = 4):
+        self._sharded = sharded
+        self._chain = key
+        self.depth = max(1, int(depth))
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+
+    def _gen(self) -> None:
+        self._chain, k = jax.random.split(self._chain)
+        self._queue.append((self._sharded.device_keys(k), self._chain))
+
+    def refill(self) -> None:
+        """Top the queue up to ``depth`` (staging-thread side)."""
+        with self._lock:
+            while len(self._queue) < self.depth:
+                self._gen()
+
+    def take(self):
+        """``(placed_per_chip_keys, chain_state_after)`` for the next
+        dispatch, generating inline if the prefetch ran dry."""
+        with self._lock:
+            if not self._queue:
+                self._gen()
+            return self._queue.popleft()
+
+
 @dataclass
 class PipelineState:
     """Trainer-counter snapshot the staging thread groups by.  ``behind``
@@ -210,11 +309,20 @@ class IngestPipeline:
                  capacity: int | None = None,
                  frame_capacity: int | None = None,
                  poll_timeout: float = 0.01,
-                 put_device: bool | None = None):
+                 put_device: bool | None = None,
+                 sharded=None, key=None, key_prefetch: int = 4):
         self.pool = pool
         self.depth = max(1, int(depth))
-        self.scan_steps = max(1, int(scan_steps))
+        # dp>1 (``sharded`` = the ShardedLearner): every polled message is
+        # one whole round-robin group; the scan stack doesn't apply (the
+        # sharded plan has no multi-step program) — group merging is the
+        # ingest-only coalescing dimension instead
+        self.sharded = sharded
+        self.scan_steps = 1 if sharded is not None else max(1,
+                                                            int(scan_steps))
         self.merge_max = max(1, int(merge_max))
+        self.keys = (KeyPrefetcher(sharded, key, depth=key_prefetch)
+                     if sharded is not None and key is not None else None)
         self.state_fn = state_fn or PipelineState
         self.capacity = capacity
         self.frame_capacity = frame_capacity
@@ -225,7 +333,15 @@ class IngestPipeline:
             # device_put costs more than the jit call's own zero-distance
             # ingestion of numpy operands (measured ~150us/leaf)
             put_device = jax.default_backend() != "cpu"
-        self._stage = jax.device_put if put_device else (lambda x: x)
+        if not put_device:
+            self._stage = lambda x: x
+        elif sharded is not None:
+            # group slots carry the dp axis in front: place each shard's
+            # slice on its chip (NamedSharding over dp) so the sharded
+            # dispatch finds its operands already in local HBM
+            self._stage = sharded.shard_put
+        else:
+            self._stage = jax.device_put
         self.put_device = put_device
         self._ring: queue_lib.Queue = queue_lib.Queue(maxsize=self.depth)
         self._stop = threading.Event()
@@ -322,6 +438,11 @@ class IngestPipeline:
         try:
             while not self._stop.is_set():
                 self._serve_publish()
+                if self.keys is not None:
+                    # keep the per-chip key prefetch full: each entry
+                    # buys one dispatch a host split + sharded put it no
+                    # longer pays on the hot loop
+                    self.keys.refill()
                 # NOTE: no ring-full pre-check — the blocking _put IS the
                 # backpressure (bound: depth slots + one group in flight),
                 # and a condition-variable wakeup hands the consumer the
@@ -366,6 +487,11 @@ class IngestPipeline:
                 return self._build_merged_slot(first)
         return self._single_slot(first,
                                  planned=1 if st.train_eligible else 0)
+
+    def _merge(self, msgs: list[dict]) -> dict:
+        if self.sharded is not None:
+            return merge_group_messages(msgs, self.sharded.n_dp)
+        return merge_chunk_messages(msgs)
 
     def _build_scan_slot(self, first: dict) -> StagedSlot:
         from apex_tpu.parallel.aggregate import stack_chunk_messages
@@ -421,7 +547,7 @@ class IngestPipeline:
             if j == 1:
                 slot = self._single_slot(take[0], planned=0)
                 continue
-            merged = merge_chunk_messages(take)
+            merged = self._merge(take)
             self.stats["merged_slots"] += 1
             self.stats["merged_chunks"] += j
             self.stats["slots"] += 1
@@ -443,19 +569,24 @@ class IngestPipeline:
             n_trans=int(msg["n_trans"]), planned_steps=planned)
 
     def _merge_cap(self, payload) -> int:
-        """Max chunks mergeable with ``payload`` as the first member: the
-        payload must be a frame chunk and the merged shapes must still
-        fit the pool's validation bounds (m*K <= capacity keeps the
-        transition scatter duplicate-free; m*Kf <= frame_capacity keeps
-        the ring write in bounds)."""
+        """Max chunks (dp>1: groups) mergeable with ``payload`` as the
+        first member: the payload must be a frame chunk and the merged
+        shapes must still fit the pool's validation bounds (m*K <=
+        capacity keeps the transition scatter duplicate-free; m*Kf <=
+        frame_capacity keeps the ring write in bounds).  Sharded group
+        payloads carry the dp axis in front, and the bounds are
+        PER-SHARD (capacity/frame_capacity describe one chip's shard),
+        so the per-shard chunk shapes at axis 1 are what must fit."""
         if not is_frame_chunk(payload):
             return 1
+        ax = 1 if self.sharded is not None else 0
         cap = self.merge_max
         if self.capacity is not None:
-            cap = min(cap, self.capacity // max(1, payload["action"].shape[0]))
+            cap = min(cap,
+                      self.capacity // max(1, payload["action"].shape[ax]))
         if self.frame_capacity is not None:
             cap = min(cap, self.frame_capacity
-                      // max(1, payload["frames"].shape[0]))
+                      // max(1, payload["frames"].shape[ax]))
         return max(1, cap)
 
     def _put(self, slot: StagedSlot) -> None:

@@ -443,9 +443,18 @@ def bench_fused_step() -> dict:
 
 # -- part 1b: async ingest pipeline on vs off -------------------------------
 
-def bench_ingest_pipeline() -> dict:
+def bench_ingest_pipeline(n_dp: int = 1) -> dict:
     """The per-ingest framepool hot loop through the REAL concurrent
     trainer, pipeline ON vs OFF, same pre-recorded chunk stream.
+
+    ``n_dp > 1`` runs the SAME A/B over the sharded (shard_map) plan:
+    chunks round-robin onto ``n_dp`` replay shards through the
+    ChunkAggregator, the pipelined lane stages whole groups (per-shard
+    merged when ingest-only) plus pre-split per-chip keys, and the
+    serial lane pays the per-dispatch split_ingest/device_keys cost
+    inline — the exact contrast the dp staging follow-up exists to
+    measure.  Runs in the dp child process (``--dp-pipe-child``) on the
+    host-platform-device-count emulated mesh.
 
     The stream arrives PICKLED (the decode cost every real data plane
     pays — mp.Queue pickle or socket recv) through an in-process pool, in
@@ -544,7 +553,8 @@ def bench_ingest_pipeline() -> dict:
         import jax
         import jax.numpy as jnp
 
-        from apex_tpu.training.ingest_pipeline import merge_chunk_messages
+        from apex_tpu.training.ingest_pipeline import (merge_chunk_messages,
+                                                       merge_group_messages)
 
         def cp(tree):
             return jax.tree.map(jnp.copy, tree)
@@ -552,12 +562,26 @@ def bench_ingest_pipeline() -> dict:
         key_f, key_t = jax.random.split(jax.random.key(999))
         beta = jnp.float32(0.4)
         merge_max = trainer.cfg.learner.pipeline_merge
-        msgs = [pickle.loads(b) for b in blobs[:merge_max]]
+        msgs = [pickle.loads(b) for b in blobs[:merge_max * max(1, n_dp)]]
+        if n_dp > 1:
+            # the dp lanes dispatch GROUP-granular payloads (aggregator
+            # stacking); merged widths per-shard-merge whole groups
+            from apex_tpu.parallel.aggregate import stack_chunk_messages
+            groups = []
+            for i in range(0, len(msgs) - n_dp + 1, n_dp):
+                payload, gprios, n_tr = stack_chunk_messages(
+                    msgs[i:i + n_dp])
+                groups.append({"payload": payload, "priorities": gprios,
+                               "n_trans": n_tr})
+            msgs = groups
+            merge = lambda mm: merge_group_messages(mm, n_dp)  # noqa: E731
+        else:
+            merge = merge_chunk_messages
 
         def forms(msg):
             payload = msg["payload"]
             prios = np.asarray(msg["priorities"], np.float32)
-            if pipeline_on:      # staged slots arrive as device arrays
+            if pipeline_on and n_dp == 1:  # staged slots: device arrays
                 return jax.device_put(payload), jax.device_put(prios)
             return payload, jnp.asarray(prios)
 
@@ -573,7 +597,7 @@ def bench_ingest_pipeline() -> dict:
         if pipeline_on:
             w, outs = 2, []
             while w <= merge_max and w <= len(msgs):
-                mpay, mpr = forms(merge_chunk_messages(msgs[:w]))
+                mpay, mpr = forms(merge(msgs[:w]))
                 outs.append(trainer._ingest(cp(trainer.replay_state),
                                             mpay, mpr))
                 w *= 2
@@ -588,7 +612,8 @@ def bench_ingest_pipeline() -> dict:
                                   compute_dtype="float32",
                                   target_update_interval=500,
                                   ingest_pipeline=pipeline_on,
-                                  pipeline_merge=32),
+                                  pipeline_merge=32,
+                                  mesh_shape=(n_dp,)),
             actor=ActorConfig(n_actors=1, send_interval=chunk_k),
         )
         trainer = ApexTrainer(cfg, pool=_PickledStreamPool(blobs),
@@ -625,10 +650,70 @@ def bench_ingest_pipeline() -> dict:
     pipelined = lane(True)
     speedup = (pipelined["trans_per_sec"] / serial["trans_per_sec"]
                if serial["trans_per_sec"] else None)
-    return {"geometry": f"cartpole-mlp_b{batch}_k{chunk_k}",
+    return {"geometry": f"cartpole-mlp_b{batch}_k{chunk_k}"
+                        + (f"_dp{n_dp}" if n_dp > 1 else ""),
+            "n_dp": n_dp,
             "train_ratio": ratio, "steps": steps,
             "serial": serial, "pipelined": pipelined,
             "speedup": None if speedup is None else round(speedup, 3)}
+
+
+# -- part 1c: the dp>1 lane in a device-count-emulated child ----------------
+
+DP_PIPE_DEVICES = int(os.environ.get("BENCH_DP_PIPE_DEVICES", 4))
+DP_PIPE_TIMEOUT = float(os.environ.get("BENCH_DP_PIPE_TIMEOUT", 420.0))
+
+
+def _dp_pipe_child() -> None:
+    """Child entry (``bench.py --dp-pipe-child``): run the part-1b A/B
+    over the sharded plan and print ONE JSON line.  The parent launched
+    us with JAX_PLATFORMS=cpu and
+    ``--xla_force_host_platform_device_count=DP_PIPE_DEVICES`` — device
+    count is a process-startup flag, so the dp mesh can only exist in a
+    fresh interpreter (the parent's backend is already initialized).
+
+    Default chunk size is SMALLER than the single-shard lane's: a
+    round-robin group is ``n_dp`` chunks, so equal-size chunks would
+    start the serial dp lane with its dispatch overhead already
+    amortized n_dp-fold and the A/B would measure mostly the merge copy
+    cost.  chunk 32 x dp 4 keeps the per-dispatch transition quantum
+    (128) equal to the single-shard lane's — the same
+    dispatch-overhead-dominant regime, now over the shard_map plan."""
+    _apply_platform()
+    os.environ.setdefault("BENCH_PIPE_CHUNK",
+                          os.environ.get("BENCH_DP_PIPE_CHUNK", "32"))
+    try:
+        out = bench_ingest_pipeline(n_dp=DP_PIPE_DEVICES)
+    except Exception as exc:
+        out = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    print(json.dumps(out), flush=True)
+
+
+def bench_ingest_pipeline_dp() -> dict:
+    """Spawn the dp>1 pipeline A/B on a CPU mesh emulated via
+    ``--xla_force_host_platform_device_count`` in a subprocess, and
+    relay its JSON (with per-lane DispatchGapTimer stats, so the
+    multichip artifacts pick up the sharded loop's gap trend)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count"
+            f"={DP_PIPE_DEVICES}").strip()
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dp-pipe-child"],
+            capture_output=True, text=True, timeout=DP_PIPE_TIMEOUT,
+            env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"dp child exceeded {DP_PIPE_TIMEOUT}s"}
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"error": (p.stderr or p.stdout or "dp child: no output")[-400:]}
 
 
 # -- part 2: end-to-end pixel pipeline -------------------------------------
@@ -793,6 +878,11 @@ def main() -> None:
             pipe = {"error": f"{type(exc).__name__}: {exc}"[:400]}
         with _print_lock:
             RESULT["ingest_pipeline"] = pipe
+        # dp>1 variant of the same A/B, in its own emulated-mesh child —
+        # a subprocess, so a hang or crash there costs only this field
+        _arm("ingest_pipeline_dp", DP_PIPE_TIMEOUT + 30)
+        with _print_lock:
+            RESULT["ingest_pipeline_dp"] = bench_ingest_pipeline_dp()
 
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU
@@ -880,6 +970,9 @@ def _finish() -> None:
 
 
 if __name__ == "__main__":
+    if "--dp-pipe-child" in sys.argv:
+        _dp_pipe_child()           # one JSON line; no watchdog, the
+        sys.exit(0)                # parent holds the hard timeout
     try:
         main()
     except BaseException as exc:   # a CRASH (vs hang) must also emit the

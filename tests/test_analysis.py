@@ -325,6 +325,19 @@ def test_j004_fires_in_nested_def_scope():
         """, "J004")
 
 
+def test_j004_silent_on_introspection_calls():
+    # getattr/isinstance/len read type facts, not PRNG material — a
+    # dtype dispatch before the single real consumption is not a reuse
+    # (the sharded-plan key wrappers in training/apex.py do exactly this)
+    assert not fires("""
+        import jax
+        def dispatch(key, sl):
+            if getattr(key, "dtype", None) == "uint32":
+                return key
+            return sl.device_keys(key)
+        """, "J004")
+
+
 # -- J005: jit inside a loop ------------------------------------------------
 
 def test_j005_fires_in_loop():
@@ -418,6 +431,54 @@ def test_j006_silent_in_jitted_scope():
                 y = jax.device_get(x)
             return y
         """, "J006")
+
+
+# -- J007: device_put inside jitted/shard_map scope -------------------------
+
+def test_j007_fires_on_device_put_in_jit():
+    assert fires("""
+        import jax
+        @jax.jit
+        def fused_step(ts, batch):
+            batch = jax.device_put(batch)
+            return update(ts, batch)
+        """, "J007")
+
+
+def test_j007_fires_inside_shard_map_body():
+    """shard_map bodies are jitted scope: the mapped per-chip fn always
+    runs inside the compiled program (jit detection seeds on any
+    shard_map / shard_map_compat call)."""
+    assert fires("""
+        import jax
+        from apex_tpu.parallel.mesh import shard_map_compat
+        def make_step(mesh, spec):
+            def per_chip(rs, ingest):
+                ingest = jax.device_put(ingest)
+                return add(rs, ingest)
+            return jax.jit(shard_map_compat(
+                per_chip, mesh=mesh, in_specs=spec, out_specs=spec))
+        """, "J007")
+
+
+def test_j007_silent_on_host_side_staging():
+    """The staging thread's device_put — OUTSIDE any jitted scope — is
+    the sanctioned pattern the rule points at."""
+    assert not fires("""
+        import jax
+        def stage(slot, sharding):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), slot)
+        """, "J007")
+
+
+def test_j007_silent_on_unrelated_attr():
+    assert not fires("""
+        import jax
+        @jax.jit
+        def step(ts, pool):
+            return pool.device_put_count
+        """, "J007")
 
 
 # -- C001: process start after a live thread --------------------------------

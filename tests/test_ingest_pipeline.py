@@ -302,7 +302,9 @@ def test_pipelined_loop_bit_parity_with_serial():
 
 
 def test_trainer_pipeline_gate():
-    """dp>1 and ingest_pipeline=False both keep the serial loop."""
+    """ingest_pipeline=False keeps the serial drain (the A/B lane);
+    default-on covers single-shard AND dp>1 (the sharded plan's parity
+    pin lives in tests/test_sharded_pipeline.py)."""
     from apex_tpu.training.apex import ApexTrainer
 
     cfg = small_test_config()
@@ -312,3 +314,7 @@ def test_trainer_pipeline_gate():
     assert not t._use_pipeline()
     t2 = ApexTrainer(cfg, pool=ScriptedPool([]))
     assert t2._use_pipeline()
+    cfg_dp = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, mesh_shape=(4,), batch_size=32, ingest_chunk=32))
+    t3 = ApexTrainer(cfg_dp, pool=ScriptedPool([]))
+    assert t3.n_dp == 4 and t3._use_pipeline()

@@ -158,6 +158,9 @@ def test_ring_random_sequences_match_fifo_model():
     agree (single-process; the MPSC test covers cross-process)."""
     from collections import deque
 
+    # gate, don't fail: some images ship without hypothesis, and the
+    # MPSC + FIFO unit tests above still cover the ring's contract there
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings
     from hypothesis import strategies as st
 

@@ -8,7 +8,7 @@ import pytest
 
 from apex_tpu.models.dueling import DuelingDQN
 from apex_tpu.parallel.learner import ShardedLearner
-from apex_tpu.parallel.mesh import make_mesh
+from apex_tpu.parallel.mesh import make_mesh, shard_map_compat
 from apex_tpu.training.learner import build_learner
 
 
@@ -121,6 +121,24 @@ def test_sharded_r2d2_fused_step_runs_and_replicates(key):
     assert jax.tree.leaves(ts.params)[0].sharding.is_fully_replicated
 
 
+def test_dp_divisibility_guards_are_value_errors(key):
+    """The batch/dp and chunk/dp guards must survive ``python -O`` (a
+    bare assert would vanish and fail later as an opaque reshape inside
+    the shard_map trace) and must name the config knobs to fix."""
+    mesh = make_mesh()
+    model = DuelingDQN(num_actions=3, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=False)
+    example = jnp.zeros((1, 6), jnp.float32)
+    core, _, _ = build_learner(model, 256, example, key, batch_size=60)
+    sl = ShardedLearner(core, mesh)            # 60 % 8 != 0
+    with pytest.raises(ValueError, match="batch_size"):
+        sl.make_fused_step()
+    with pytest.raises(ValueError, match="mesh_shape"):
+        sl.make_train_step()
+    with pytest.raises(ValueError, match="send_interval"):
+        sl.split_ingest({"x": np.arange(12)}, np.arange(12.0))
+
+
 def test_split_ingest_round_robin():
     mesh = make_mesh()
     core_dummy = None  # split_ingest only uses n_dp
@@ -165,7 +183,7 @@ def test_dp8_update_matches_single_device_math(key):
         return new_ts, m
 
     shard = lambda x: x.reshape((8, 8) + x.shape[1:])  # noqa: E731
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         per_chip, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
         out_specs=(P(), P()), check_vma=False)
     ts8, m8 = jax.jit(mapped)(ts, jax.tree.map(shard, batch),
@@ -254,7 +272,7 @@ def test_sharded_is_weights_correct_under_skew(key):
                                   axis_name="dp")
         return w[None], idx[None]
 
-    sample = jax.jit(jax.shard_map(
+    sample = jax.jit(shard_map_compat(
         per_chip, mesh=mesh, in_specs=(P("dp"), P("dp")),
         out_specs=(P("dp"), P("dp")), check_vma=False))
     w, idx = sample(rs, sl.device_keys(jax.random.key(3)))
