@@ -129,22 +129,26 @@ def aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
 
 
 class VectorAQLWorkerFamily(VectorFamilyBase):
-    """B-env AQL acting: one batched propose+score per step, per-slot
-    transition builders — the AQL counterpart of
+    """B-env AQL acting: one batched propose+score per half-group under
+    the base's double-buffered step, per-slot transition builders — the
+    AQL counterpart of
     :class:`apex_tpu.actors.vector.VectorDQNWorkerFamily`, sharing its
     scaffolding through :class:`~apex_tpu.actors.vector.VectorFamilyBase`
     and driven by the same family-agnostic ``vector_worker_loop``."""
 
     def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
                  slot_ids, epsilons, chunk_transitions: int):
-        import jax
-
         from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
         from apex_tpu.training.aql import AQLTransitionBuilder
 
         super().__init__(cfg, seeds, slot_ids, epsilons)
-        self._obs: list = [None] * self.n_envs
-        self.policy = jax.jit(make_aql_policy_fn(AQLNetwork(**model_spec)))
+        # in-place obs assembly: the policy consumes contiguous slices of
+        # one preallocated [B, *obs] buffer instead of a per-step np.stack
+        space = self.envs[0].observation_space
+        self._acting = np.zeros((self.n_envs,) + tuple(space.shape),
+                                space.dtype)
+        self.policy = self._grouped_policy(
+            make_aql_policy_fn(AQLNetwork(**model_spec)))
         self.builders = [AQLTransitionBuilder(cfg.learner.gamma)
                          for _ in range(self.n_envs)]
         self.chunk_transitions = chunk_transitions
@@ -155,26 +159,23 @@ class VectorAQLWorkerFamily(VectorFamilyBase):
                         max_episode_steps=self.cfg.actor.max_episode_length)
 
     def _on_reset(self, i: int, obs) -> None:
-        self._obs[i] = np.asarray(obs)
+        self._acting[i] = np.asarray(obs)
 
-    def step_all(self, params, key) -> list:
-        import jax.numpy as jnp
+    def _policy_group(self, params, sl, eps, key, group: int):
+        return self.policy(params, self._acting[sl], eps, key, group)
 
-        obs_batch = np.stack(self._obs)
-        actions, idx, a_mu, q = self.policy(
-            params, obs_batch, jnp.asarray(self._current_eps()), key)
-        actions, idx = np.asarray(actions), np.asarray(idx)
-        a_mu, q = np.asarray(a_mu), np.asarray(q)
-
-        stats: list = []
-        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
-            next_obs, reward, term, trunc, _ = env.step(actions[i])
-            builder.add_step(self._obs[i], int(idx[i]), float(reward),
-                             np.asarray(next_obs), a_mu[i], q[i],
-                             bool(term), bool(trunc))
-            self._obs[i] = np.asarray(next_obs)
+    def _step_group(self, sl, host, stats) -> None:
+        actions, idx, a_mu, q = host
+        for j, i in enumerate(range(sl.start, sl.stop)):
+            # the builder keeps obs beyond this step; copy it out of the
+            # in-place buffer before the row is overwritten
+            obs = np.array(self._acting[i])
+            next_obs, reward, term, trunc, _ = self.envs[i].step(actions[j])
+            self.builders[i].add_step(obs, int(idx[j]), float(reward),
+                                      np.asarray(next_obs), a_mu[j], q[j],
+                                      bool(term), bool(trunc))
+            self._acting[i] = np.asarray(next_obs)
             self._finish_step(i, float(reward), bool(term or trunc), stats)
-        return stats
 
     def poll_msgs(self) -> list[dict]:
         out = []
@@ -188,15 +189,14 @@ class VectorAQLWorkerFamily(VectorFamilyBase):
 
 class VectorAQLPixelWorkerFamily(VectorChunkFamilyBase):
     """B-env frame-pool AQL acting: the vector counterpart of
-    :class:`AQLPixelWorkerFamily` — one batched propose+score over the
-    slots' acting stacks, per-slot chunk builders with ``a_mu`` sidecars.
-    Env construction, builder resets, and chunk draining come from
+    :class:`AQLPixelWorkerFamily` — batched propose+score over each
+    half-group's slice of the in-place acting buffer, per-slot chunk
+    builders with ``a_mu`` sidecars.  Env construction, builder resets,
+    acting-buffer binding, and chunk draining come from
     :class:`~apex_tpu.actors.vector.VectorChunkFamilyBase`."""
 
     def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
                  slot_ids, epsilons, chunk_transitions: int):
-        import jax
-
         from apex_tpu.envs.registry import unstacked_env_spec
         from apex_tpu.models.aql import AQLNetwork, make_aql_policy_fn
         from apex_tpu.replay.frame_chunks import FrameChunkBuilder
@@ -205,7 +205,7 @@ class VectorAQLPixelWorkerFamily(VectorChunkFamilyBase):
         frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
             self.envs[0], cfg.env)
         model = AQLNetwork(**model_spec)
-        self.policy = jax.jit(make_aql_policy_fn(model))
+        self.policy = self._grouped_policy(make_aql_policy_fn(model))
         a_dim = 1 if model.discrete else model.action_dim
         self.builders = [
             FrameChunkBuilder(
@@ -215,24 +215,19 @@ class VectorAQLPixelWorkerFamily(VectorChunkFamilyBase):
                 extra_shapes={"a_mu": (model.total_sample, a_dim)})
             for _ in range(self.n_envs)
         ]
+        self._bind_acting_buffer()
 
-    def step_all(self, params, key) -> list:
-        import jax.numpy as jnp
+    def _policy_group(self, params, sl, eps, key, group: int):
+        return self.policy(params, self._acting[sl], eps, key, group)
 
-        stacks = np.stack([b.current_stack() for b in self.builders])
-        actions, idx, a_mu, q = self.policy(
-            params, stacks, jnp.asarray(self._current_eps()), key)
-        actions, idx = np.asarray(actions), np.asarray(idx)
-        a_mu, q = np.asarray(a_mu), np.asarray(q)
-
-        stats: list = []
-        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
-            next_obs, reward, term, trunc, _ = env.step(actions[i])
-            builder.add_step(int(idx[i]), float(reward), q[i], next_obs,
-                             bool(term), bool(trunc),
-                             extras={"a_mu": a_mu[i]})
+    def _step_group(self, sl, host, stats) -> None:
+        actions, idx, a_mu, q = host
+        for j, i in enumerate(range(sl.start, sl.stop)):
+            next_obs, reward, term, trunc, _ = self.envs[i].step(actions[j])
+            self.builders[i].add_step(int(idx[j]), float(reward), q[j],
+                                      next_obs, bool(term), bool(trunc),
+                                      extras={"a_mu": a_mu[j]})
             self._finish_step(i, float(reward), bool(term or trunc), stats)
-        return stats
 
 
 def vector_aql_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,

@@ -54,6 +54,32 @@ class EpisodeStat:
     reward: float
     length: int
     param_version: int = 0          # staleness observability
+    # stats the worker dropped on a full stat_queue since its LAST
+    # successful put (the drop itself stays lossy — bounded queue — but
+    # the loss is now counted, so reward/staleness accounting is
+    # auditably incomplete rather than silently incomplete)
+    dropped_stats: int = 0
+
+
+@dataclass
+class ActorTimingStat:
+    """Periodic actor-plane observability message (one per worker every
+    ``ActorConfig.timing_interval`` vector steps): where the worker's wall
+    time went — policy-wait vs env-step vs drain — plus its frames/s and
+    the host gap between policy dispatches.  Ships on the same stat queue
+    as :class:`EpisodeStat`; the learner's stats drain dispatches on type
+    (``training/apex.py``) and the e2e bench aggregates these into its
+    ``actor_plane`` section."""
+
+    actor_id: int                   # worker index (process), not env slot
+    frames_per_sec: float           # env frames/s over the window
+    policy_wait_frac: float         # blocking materialization of outputs
+    env_step_frac: float            # env.step + builder recording
+    drain_frac: float               # chunk poll + queue put (backpressure)
+    dispatch_gap_ms_p50: float      # host gap between policy dispatches
+    vector_steps: int               # window length in vector steps
+    double_buffer: bool             # mode the worker is running
+    dropped_stats: int = 0          # same carry semantics as EpisodeStat
 
 
 def drain_builder_chunks(builder) -> list[dict]:
@@ -148,6 +174,8 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     obs, _ = env.reset(seed=family.seed)
     family.begin_episode(obs)
     ep_reward, ep_len = 0.0, 0
+    dropped = 0                     # stats lost to a full queue, carried
+    #                                 on the next successful put
 
     while not stop_event.is_set():
         steps_since_poll += 1
@@ -171,9 +199,11 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         if terminated or truncated:
             try:
                 stat_queue.put_nowait(
-                    EpisodeStat(actor_id, ep_reward, ep_len, version))
+                    EpisodeStat(actor_id, ep_reward, ep_len, version,
+                                dropped_stats=dropped))
+                dropped = 0
             except queue_lib.Full:
-                pass
+                dropped += 1
             ep_reward, ep_len = 0.0, 0
             obs, _ = env.reset()
             family.begin_episode(obs)

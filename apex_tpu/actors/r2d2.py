@@ -17,6 +17,8 @@ host (the builder's ``needs_carry`` gate).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from apex_tpu.config import ApexConfig
@@ -236,6 +238,11 @@ class VectorR2D2WorkerFamily:
         self.group = group
         self._ready: list[dict] = []
 
+    # the recurrent carry [B, H] advances in lockstep through ONE batched
+    # call, so this family runs the serial interleave regardless of
+    # ActorConfig.double_buffer (a group split would also split the carry)
+    double_buffer = False
+
     # base delegation (vector_worker_loop drives these)
     @property
     def seeds(self):
@@ -244,6 +251,14 @@ class VectorR2D2WorkerFamily:
     @property
     def n_envs(self):
         return self.base.n_envs
+
+    @property
+    def phase(self):
+        return self.base.phase
+
+    @property
+    def gap(self):
+        return self.base.gap
 
     def reset_all(self) -> None:
         self.base.reset_all()
@@ -259,12 +274,16 @@ class VectorR2D2WorkerFamily:
         if any(need):           # ONE batched device->host carry transfer
             cc_all = np.asarray(self.carry[0])
             ch_all = np.asarray(self.carry[1])
+        self.gap.about_to_dispatch()
         actions, q, self.carry = self.policy(
             params, obs, self.carry,
             jnp.asarray(self.base._current_eps()), key)
-        actions, q = np.asarray(actions), np.asarray(q)
+        self.gap.dispatch_returned()
+        with self.phase.phase("policy_wait"):
+            actions, q = np.asarray(actions), np.asarray(q)
 
         stats: list = []
+        env_t0 = time.perf_counter()
         for i, env in enumerate(self.base.envs):
             a = int(actions[i])
             next_obs, reward, term, trunc, _ = env.step(a)
@@ -282,6 +301,7 @@ class VectorR2D2WorkerFamily:
             # on done: auto-reset calls _on_reset (obs + carry-row zero)
             self.base._finish_step(i, float(reward), bool(term or trunc),
                                    stats)
+        self.phase.add("env_step", time.perf_counter() - env_t0)
         return stats
 
     def poll_msgs(self) -> list[dict]:

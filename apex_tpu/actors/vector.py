@@ -29,6 +29,26 @@ Chunks from all slots ship on the same bounded queue; backpressure applies
 to the whole process (a full queue blocks all B slots — strictly stronger
 than the scalar fleet's per-process blocking, preserving the end-to-end
 flow control).
+
+The vector hot loop is ALTERNATING DOUBLE-BUFFERED (Stooke & Abbeel,
+*Accelerated Methods for Deep RL*): the B slots split into two half-groups
+A/B, the per-step key derives one subkey per group via
+``fold_in(step_key, group)``, and with ``ActorConfig.double_buffer`` on the
+jitted policy for BOTH groups dispatches asynchronously before any result
+is materialized — group A's env stepping then runs on the host while the
+device still computes group B's inference.  The serial interleave
+(``double_buffer=False``) dispatches, materializes, and steps one group at
+a time with the SAME group split and the SAME per-group keys, so the two
+modes are bit-identical per slot (actions, chunks, priorities — pinned in
+``tests/test_vector.py``); the knob is a pure scheduling A/B.  Acting
+stacks are assembled IN PLACE: one preallocated contiguous
+``[B, *stacked]`` buffer whose rows the per-slot
+:class:`~apex_tpu.replay.frame_chunks.FrameChunkBuilder`\\ s maintain
+through bound views — the policy consumes buffer slices directly, no
+per-step ``np.stack`` of B copied stacks.  Each step's wall time is split
+into policy-wait / env-step / drain phases
+(:class:`~apex_tpu.utils.profiling.PhaseTimer`) and shipped periodically
+as :class:`~apex_tpu.actors.pool.ActorTimingStat`.
 """
 
 from __future__ import annotations
@@ -49,22 +69,52 @@ class VectorFamilyBase:
     recorders per algorithm (``batchrecorder.py`` vs
     ``batchrecoder_AQL.py``), the defect this hierarchy exists to avoid.
 
-    Subclasses provide ``_make_env(seed)``, ``_on_reset(i, obs)`` and
-    ``step_all``; the latter calls :meth:`_finish_step` per slot to get
-    uniform accounting/reset behavior.
+    Subclasses provide ``_make_env(seed)``, ``_on_reset(i, obs)``, and the
+    per-group hooks ``_policy_group``/``_step_group`` consumed by the
+    shared double-buffered :meth:`step_all` template (module docstring);
+    ``_step_group`` calls :meth:`_finish_step` per slot to get uniform
+    accounting/reset behavior.
     """
 
     def __init__(self, cfg: ApexConfig, seeds, slot_ids, epsilons):
+        from apex_tpu.utils.profiling import DispatchGapTimer, PhaseTimer
+
         self.cfg = cfg
         self.seeds = list(seeds)
         self.slot_ids = list(slot_ids)
         self.epsilons = np.asarray(epsilons, np.float32)
         self.n_envs = len(self.seeds)
-        assert self.n_envs == len(self.slot_ids) == len(self.epsilons)
+        if not (self.n_envs == len(self.slot_ids) == len(self.epsilons)):
+            # survives `python -O`, unlike the assert it replaces: a
+            # mis-derived slot band would run the wrong exploration
+            # spectrum for the whole process
+            raise ValueError(
+                f"vector worker slot arity mismatch: {len(self.seeds)} "
+                f"seeds, {len(self.slot_ids)} slot_ids, "
+                f"{len(self.epsilons)} epsilons — all three derive from "
+                f"ActorConfig.n_envs_per_actor x ActorConfig.n_actors "
+                f"(see worker_slots); check those knobs")
         self.envs = [self._make_env(s) for s in self.seeds]
         self.ep_reward = np.zeros(self.n_envs, np.float64)
         self.ep_len = np.zeros(self.n_envs, np.int64)
         self.slot_steps = np.zeros(self.n_envs, np.int64)
+        # alternating double-buffer state: two half-groups (first takes
+        # the odd slot), serial fallback when there is nothing to overlap
+        half = (self.n_envs + 1) // 2
+        self.groups = [sl for sl in (slice(0, half),
+                                     slice(half, self.n_envs))
+                       if sl.stop > sl.start]
+        self.double_buffer = (
+            bool(getattr(cfg.actor, "double_buffer", True))
+            and self.n_envs >= 2)
+        # per-group device epsilon cache (anneal off => the ladder is a
+        # constant; re-uploading it every dispatch costs a host->device
+        # conversion per group per step)
+        self._eps_cache: list | None = None
+        # actor-plane observability: per-phase wall fractions + the host
+        # gap between policy dispatches (both pure host timing)
+        self.phase = PhaseTimer()
+        self.gap = DispatchGapTimer()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -76,6 +126,89 @@ class VectorFamilyBase:
     def close(self) -> None:
         for env in self.envs:
             env.close()
+
+    # -- the double-buffered vector step -----------------------------------
+
+    def step_all(self, params, key) -> list:
+        """One vector step over all B slots.  Both modes derive one subkey
+        per half-group (``fold_in(key, group)`` — folded INSIDE the jitted
+        group call, so the derivation costs no extra dispatch) and run the
+        policy per group; double-buffered, every group's inference
+        dispatches BEFORE any result is materialized, so group A's env
+        stepping overlaps group B's device compute.  Returns stats for
+        slots whose episodes ended (those are auto-reset)."""
+        stats: list = []
+        eps = self._group_eps()
+        if self.double_buffer:
+            outs = []
+            for g, sl in enumerate(self.groups):
+                self.gap.about_to_dispatch()
+                # apexlint: disable=J004 -- each group call folds key with its group id inside the jit: distinct subkeys, no reuse
+                outs.append(self._policy_group(params, sl, eps[g], key, g))
+                self.gap.dispatch_returned()
+            for sl, out in zip(self.groups, outs):
+                with self.phase.phase("policy_wait"):
+                    host = self._materialize(out)
+                with self.phase.phase("env_step"):
+                    self._step_group(sl, host, stats)
+        else:
+            for g, sl in enumerate(self.groups):
+                self.gap.about_to_dispatch()
+                # apexlint: disable=J004 -- each group call folds key with its group id inside the jit: distinct subkeys, no reuse
+                out = self._policy_group(params, sl, eps[g], key, g)
+                self.gap.dispatch_returned()
+                with self.phase.phase("policy_wait"):
+                    host = self._materialize(out)
+                with self.phase.phase("env_step"):
+                    self._step_group(sl, host, stats)
+        return stats
+
+    def _group_eps(self) -> list:
+        """Per-group epsilon arrays for this step — device-cached while
+        the anneal is off (the ladder is constant), recomputed per step
+        otherwise."""
+        if not self.cfg.actor.eps_anneal_steps:
+            if self._eps_cache is None:
+                import jax.numpy as jnp
+                self._eps_cache = [jnp.asarray(self.epsilons[sl])
+                                   for sl in self.groups]
+            return self._eps_cache
+        eps = self._current_eps()
+        return [eps[sl] for sl in self.groups]
+
+    @staticmethod
+    def _grouped_policy(policy_fn):
+        """Jit ``policy_fn`` with the per-group key derivation fused in:
+        the call receives the RAW per-step key plus its group id and folds
+        inside the compiled program — bit-identical to a host-side
+        ``fold_in`` at zero extra dispatches."""
+        import jax
+
+        def grouped(params, obs, eps, key, group):
+            return policy_fn(params, obs, eps,
+                             jax.random.fold_in(key, group))
+
+        # group is structural (which half), not data: static avoids a
+        # per-call scalar transfer at the cost of one compile per group
+        return jax.jit(grouped, static_argnums=(4,))
+
+    def _policy_group(self, params, sl: slice, eps, key, group: int):
+        """Dispatch the jitted policy for the slots in ``sl``; must return
+        device arrays WITHOUT materializing them (the double-buffered
+        interleave defers every blocking host copy to the consumption
+        site)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _materialize(out) -> tuple:
+        """The one blocking device->host sync per group, immediately before
+        the group's envs consume the results."""
+        return tuple(np.asarray(x) for x in out)
+
+    def _step_group(self, sl: slice, host: tuple, stats: list) -> None:
+        """Step the envs in ``sl`` with the group's materialized policy
+        outputs and record per-slot transitions."""
+        raise NotImplementedError
 
     # -- shared stepping helpers -------------------------------------------
 
@@ -121,6 +254,20 @@ class VectorChunkFamilyBase(VectorFamilyBase):
     def _on_reset(self, i: int, obs) -> None:
         self.builders[i].begin_episode(obs)
 
+    def _bind_acting_buffer(self) -> None:
+        """Preallocate ONE contiguous ``[B, *stacked]`` acting buffer and
+        hand each builder a row view to maintain in place — the policy
+        consumes ``self._acting[group]`` slices directly, eliminating the
+        per-step ``np.stack`` of B copied stacks (and each builder's
+        per-call concatenate).  Group slices are contiguous and disjoint,
+        so mutating one group's rows while the other group's dispatched
+        policy call is still in flight can never touch that call's input."""
+        stacked = self.builders[0].stacked_shape()
+        self._acting = np.zeros((self.n_envs,) + stacked,
+                                self.builders[0].frame_dtype)
+        for i, builder in enumerate(self.builders):
+            builder.bind_acting_view(self._acting[i])
+
     def poll_msgs(self) -> list[dict]:
         from apex_tpu.actors.pool import drain_builder_chunks
         out = []
@@ -135,8 +282,6 @@ class VectorDQNWorkerFamily(VectorChunkFamilyBase):
 
     def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
                  slot_ids, epsilons, chunk_transitions: int):
-        import jax
-
         from apex_tpu.envs.registry import unstacked_env_spec
         from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
         from apex_tpu.replay.frame_chunks import FrameChunkBuilder
@@ -144,7 +289,8 @@ class VectorDQNWorkerFamily(VectorChunkFamilyBase):
         super().__init__(cfg, seeds, slot_ids, epsilons)
         frame_shape, frame_dtype, frame_stack = unstacked_env_spec(
             self.envs[0], cfg.env)
-        self.policy = jax.jit(make_policy_fn(DuelingDQN(**model_spec)))
+        self.policy = self._grouped_policy(
+            make_policy_fn(DuelingDQN(**model_spec)))
         self.builders = [
             FrameChunkBuilder(
                 cfg.learner.n_steps, cfg.learner.gamma, frame_stack,
@@ -152,34 +298,47 @@ class VectorDQNWorkerFamily(VectorChunkFamilyBase):
                 frame_dtype=frame_dtype)
             for _ in range(self.n_envs)
         ]
+        self._bind_acting_buffer()
 
-    def step_all(self, params, key) -> list[EpisodeStat]:
-        """One batched policy call, then one env.step per slot.  Returns
-        stats for slots whose episodes ended (those are auto-reset)."""
-        import jax.numpy as jnp
+    def _policy_group(self, params, sl: slice, eps, key, group: int):
+        return self.policy(params, self._acting[sl], eps, key, group)
 
-        stacks = np.stack([b.current_stack() for b in self.builders])
-        actions, q = self.policy(params, stacks,
-                                 jnp.asarray(self._current_eps()), key)
-        actions = np.asarray(actions)
-        q = np.asarray(q)
-
-        stats: list[EpisodeStat] = []
-        for i, (env, builder) in enumerate(zip(self.envs, self.builders)):
-            a = int(actions[i])
-            next_obs, reward, term, trunc, _ = env.step(a)
-            builder.add_step(a, float(reward), q[i], next_obs,
-                             bool(term), bool(trunc))
+    def _step_group(self, sl: slice, host: tuple, stats: list) -> None:
+        actions, q = host
+        for j, i in enumerate(range(sl.start, sl.stop)):
+            a = int(actions[j])
+            next_obs, reward, term, trunc, _ = self.envs[i].step(a)
+            self.builders[i].add_step(a, float(reward), q[j], next_obs,
+                                      bool(term), bool(trunc))
             self._finish_step(i, float(reward), bool(term or trunc), stats)
-        return stats
 
 
-def vector_worker_loop(actor_id: int, cfg: ApexConfig,
-                       family: VectorDQNWorkerFamily, chunk_queue,
+def _timing_stat(actor_id: int, family, steps_window: int):
+    """One :class:`~apex_tpu.actors.pool.ActorTimingStat` from the family's
+    phase/gap timers, resetting the phase window (``dropped_stats`` is
+    stamped by the put loop, like every stat)."""
+    from apex_tpu.actors.pool import ActorTimingStat
+
+    w = family.phase.window(reset=True)
+    fr = w["fracs"]
+    return ActorTimingStat(
+        actor_id=actor_id,
+        frames_per_sec=round(steps_window * family.n_envs / w["wall_s"], 1),
+        policy_wait_frac=round(fr.get("policy_wait", 0.0), 4),
+        env_step_frac=round(fr.get("env_step", 0.0), 4),
+        drain_frac=round(fr.get("drain", 0.0), 4),
+        dispatch_gap_ms_p50=family.gap.snapshot()["dispatch_gap_ms_p50"],
+        vector_steps=steps_window,
+        double_buffer=bool(getattr(family, "double_buffer", False)))
+
+
+def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
                        param_queue, stat_queue, stop_event) -> None:
     """Vector counterpart of :func:`apex_tpu.actors.pool.worker_loop`: the
     same lifecycle (interruptible first-publish wait, CONFLATE param polls,
-    chunk backpressure, clean shutdown) over B env slots."""
+    chunk backpressure, clean shutdown) over B env slots, plus the
+    actor-plane observability cadence (drain-phase timing and the periodic
+    :class:`~apex_tpu.actors.pool.ActorTimingStat`)."""
     import jax
 
     key = jax.random.key(family.seeds[0])
@@ -197,8 +356,14 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig,
     # poll cadence in VECTOR steps so staleness in env frames matches the
     # scalar worker's update_interval
     poll_every = max(1, math.ceil(cfg.actor.update_interval / family.n_envs))
+    timing_every = max(0, int(getattr(cfg.actor, "timing_interval", 0)))
     steps_since_poll = 0
+    vec_steps = 0
+    dropped = 0         # stats lost to a full queue, carried on the next
+    #                     successful put (auditably lossy, not silently)
     family.reset_all()
+    family.phase.window(reset=True)   # timing windows start at the loop,
+    #                                   not at family construction
 
     while not stop_event.is_set():
         steps_since_poll += 1
@@ -211,15 +376,23 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig,
                 pass
 
         key, akey = jax.random.split(key)
-        for stat in family.step_all(params, akey):
-            stat.param_version = version
+        stats = list(family.step_all(params, akey))
+        vec_steps += 1
+        if timing_every and vec_steps % timing_every == 0:
+            stats.append(_timing_stat(actor_id, family, timing_every))
+        for stat in stats:
+            if hasattr(stat, "param_version"):
+                stat.param_version = version
+            stat.dropped_stats = dropped
             try:
                 stat_queue.put_nowait(stat)
+                dropped = 0
             except queue_lib.Full:
-                pass
+                dropped += 1
 
-        for msg in family.poll_msgs():
-            chunk_queue.put(("chunk", actor_id, msg))     # blocks when full
+        with family.phase.phase("drain"):
+            for msg in family.poll_msgs():
+                chunk_queue.put(("chunk", actor_id, msg))  # blocks when full
 
     family.close()
 

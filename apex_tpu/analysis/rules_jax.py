@@ -588,6 +588,170 @@ class DevicePutInJit(Rule):
         return out
 
 
+# -- J008 -------------------------------------------------------------------
+
+
+_MATERIALIZE_NUMPY = {"asarray", "array"}
+
+
+def _jit_callable_names(ctx: ModuleContext) -> set[str]:
+    """Names that dispatch compiled code when called: targets assigned from
+    ``jax.jit(...)`` (``self.policy = jax.jit(...)`` -> ``policy``),
+    functions passed to ``jax.jit`` by name, and ``@jit``-decorated defs.
+    Deliberately NOT the transitive jitted-scope closure — calling a
+    helper that jitted code also calls is a plain host call."""
+    out: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not is_jit_expr(node.func):
+            continue
+        if node.args:
+            tgt = node.args[0]
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                out.add(tgt.attr)
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+    for fn in ctx.functions:
+        if any(is_jit_expr(d) for d in fn.decorator_list):
+            out.add(fn.name)
+    return out
+
+
+def _is_timed_context(expr: ast.AST) -> bool:
+    """``with phase(...)`` / ``x.phase(...)`` or a trace scope — explicit
+    wait accounting (utils/profiling.PhaseTimer), the sanctioned place to
+    block on a device result."""
+    if _is_trace_context(expr):
+        return True
+    return isinstance(expr, ast.Call) and call_name(expr) == "phase"
+
+
+@register
+class EagerJitMaterialize(Rule):
+    id = "J008"
+    name = "eager-jit-materialize"
+    description = ("np.asarray()/jax.device_get() materializing a jitted "
+                   "result in a host step loop with the value consumed "
+                   "more than one statement later: the blocking sync "
+                   "serializes the dispatch pipeline against host work "
+                   "that could overlap it — defer materialization to the "
+                   "consumption site (the double-buffered actor step, "
+                   "actors/vector.py)")
+
+    def _materializer_args(self, call: ast.Call) -> list | None:
+        f = call.func
+        if not isinstance(f, ast.Attribute) or not call.args:
+            return None
+        if f.attr in _MATERIALIZE_NUMPY and _attr_root(f) in _NUMPY_ALIASES:
+            return list(call.args)
+        if f.attr == "device_get" and _attr_root(f) in _JNP_ALIASES:
+            return list(call.args)
+        return None
+
+    @staticmethod
+    def _stmt_position(ctx: ModuleContext, stmt: ast.AST):
+        parent = ctx.parents.get(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            seq = getattr(parent, field, None)
+            if isinstance(seq, list) and stmt in seq:
+                return seq, seq.index(stmt)
+        return None, None
+
+    def _in_timed_scope(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        for a in ctx.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                if any(_is_timed_context(item.context_expr)
+                       for item in a.items):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        jit_names = _jit_callable_names(ctx)
+        if not jit_names:
+            return []
+        out: list[Finding] = []
+        for fn in ctx.functions:
+            if ctx.in_jitted_scope(fn):
+                continue                      # host-side rule
+            # values returned by a jit dispatch in this function
+            device_vars: set[str] = set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and call_name(node.value) in jit_names):
+                    for t in node.targets:
+                        elts = (t.elts if isinstance(t, (ast.Tuple,
+                                                         ast.List))
+                                else [t])
+                        device_vars.update(e.id for e in elts
+                                           if isinstance(e, ast.Name))
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                found = self._check_assign(ctx, fn, stmt, device_vars,
+                                           jit_names)
+                if found is not None:
+                    out.append(found)
+        return out
+
+    def _check_assign(self, ctx, fn, stmt: ast.Assign, device_vars,
+                      jit_names):
+        calls = (stmt.value.elts
+                 if isinstance(stmt.value, (ast.Tuple, ast.List))
+                 else [stmt.value])
+        sync = None
+        for c in calls:
+            if not isinstance(c, ast.Call):
+                continue
+            args = self._materializer_args(c)
+            if args is None:
+                continue
+            refs_device = any(
+                (isinstance(n, ast.Name) and n.id in device_vars)
+                or (isinstance(n, ast.Call) and call_name(n) in jit_names)
+                for a in args for n in ast.walk(a))
+            if refs_device:
+                sync = c
+                break
+        if sync is None or self._in_timed_scope(ctx, stmt):
+            return None
+        targets = {n.id for t in stmt.targets for n in ast.walk(t)
+                   if isinstance(n, ast.Name)}
+        seq, idx = self._stmt_position(ctx, stmt)
+        if seq is None:
+            return None
+        consumer = None
+        for dist, later in enumerate(seq[idx + 1:], start=1):
+            if any(isinstance(n, ast.Name) and n.id in targets
+                   for n in ast.walk(later)):
+                consumer = (dist, later)
+                break
+        if consumer is None:
+            return None
+        dist, later = consumer
+        if dist <= 1:
+            return None                  # materialized at the use site
+        hot = (bool(_loops_between(ctx, stmt, None))
+               or isinstance(later, (ast.For, ast.AsyncFor, ast.While)))
+        if not hot:
+            return None
+        return ctx.finding(
+            self, sync,
+            f"jitted result materialized {dist} statements before its "
+            f"first use — the blocking sync runs before host work it "
+            f"could overlap; defer np.asarray/device_get to the "
+            f"consumption site (or wrap a deliberate wait in a "
+            f"PhaseTimer.phase scope)")
+
+
 # -- J005 -------------------------------------------------------------------
 
 

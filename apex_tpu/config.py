@@ -140,6 +140,22 @@ class ActorConfig:
     # Ring slot size; 0 = drivers compute it from the frame spec (or a 4MiB
     # default when they can't).  A chunk message must fit one slot.
     shm_slot_bytes: int = 0
+    # Alternating double-buffered sampling (actors/vector.py, the Stooke &
+    # Abbeel alternating sampler): the B env slots split into two
+    # half-groups whose jitted policy calls dispatch asynchronously, so one
+    # group's env stepping overlaps the other group's inference.  Per-group
+    # PRNG keys derive via fold_in(group) on the per-step key IN BOTH
+    # MODES, so on/off trajectories are bit-identical per slot
+    # (tests/test_vector.py pins it) — the knob is a pure scheduling A/B,
+    # same discipline as LearnerConfig.ingest_pipeline.  Families fall
+    # back to the serial interleave when B < 2 (one group: nothing to
+    # overlap).  The win needs a spare host core or an off-host policy
+    # device; a 1-core box shows parity, not regression.
+    double_buffer: bool = True
+    # Vector steps between periodic ActorTimingStat emissions (policy-wait
+    # / env-step / drain fractions + frames/s, shipped on the stat queue
+    # and surfaced in the learner logs and bench "actor_plane").  0 = off.
+    timing_interval: int = 256
 
 
 @dataclass(frozen=True)

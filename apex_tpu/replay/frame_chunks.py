@@ -72,8 +72,56 @@ class FrameChunkBuilder:
         self._recent: deque = deque(maxlen=frame_stack + n_steps + 1)
         self._ep2chunk: dict[int, int] = {}
 
+        # view-backed acting-stack mode (bind_acting_view): the stack the
+        # policy reads is maintained in place inside a caller-owned buffer,
+        # rebuilt each step from rotating refs to the last S frames
+        self._acting_view: np.ndarray | None = None
+        self._view_frames: list[np.ndarray] = []
+
         self._chunks: list[dict] = []
         self._reset_chunk()
+
+    # -- view-backed acting stack ------------------------------------------
+
+    def stacked_shape(self) -> tuple[int, ...]:
+        """Shape of the policy's acting stack: S frames channel-concatenated
+        on the last axis (matches :meth:`current_stack`)."""
+        return self.frame_shape[:-1] + (self.s * self.frame_shape[-1],)
+
+    def bind_acting_view(self, view: np.ndarray) -> None:
+        """Maintain the acting stack IN PLACE inside ``view`` (typically one
+        row of a vector family's preallocated ``[B, *stacked]`` buffer).
+        After binding, :meth:`current_stack` returns ``view`` without
+        copying: ``begin_episode`` fills all S positions with the reset
+        frame and ``add_step`` rolls the channel window forward — no
+        per-step concatenate, no per-step allocation.  Callers must treat
+        the returned stack as read-only."""
+        want = self.stacked_shape()
+        if tuple(view.shape) != want or view.dtype != self.frame_dtype:
+            raise ValueError(
+                f"acting view must be {want} {self.frame_dtype}, got "
+                f"{tuple(view.shape)} {view.dtype}")
+        self._acting_view = view
+
+    def _view_reset(self, frame: np.ndarray) -> None:
+        f = np.asarray(frame, self.frame_dtype).reshape(self.frame_shape)
+        self._view_frames = [f] * self.s
+        self._view_write()
+
+    def _view_push(self, frame: np.ndarray) -> None:
+        f = np.asarray(frame, self.frame_dtype).reshape(self.frame_shape)
+        self._view_frames = self._view_frames[1:] + [f]
+        self._view_write()
+
+    def _view_write(self) -> None:
+        """Rewrite all S channel slots from the rotating frame refs.  S
+        small strided writes beat the in-place channel shift ~6x: the
+        overlapping ``v[..., :-c] = v[..., c:]`` move forces numpy through
+        its overlap-safe buffered path."""
+        v = self._acting_view
+        c = self.frame_shape[-1]
+        for j, f in enumerate(self._view_frames):
+            v[..., j * c:(j + 1) * c] = f
 
     # -- chunk buffer ------------------------------------------------------
 
@@ -111,11 +159,15 @@ class FrameChunkBuilder:
         self._recent.append((0, np.asarray(frame, self.frame_dtype)))
         self._ep2chunk = {}
         self._register_frame(0, frame)
+        if self._acting_view is not None:
+            self._view_reset(frame)
 
     def current_stack(self) -> np.ndarray:
         """The policy's input: last S frames (oldest first, channel concat),
         padded at episode start by repeating the reset frame."""
         assert self._ep_step >= 0, "begin_episode first"
+        if self._acting_view is not None:
+            return self._acting_view
         by_idx = dict(self._recent)
         frames = [by_idx[max(self._ep_step - i, 0)]
                   for i in range(self.s - 1, -1, -1)]
@@ -137,6 +189,8 @@ class FrameChunkBuilder:
         self._ep_step += 1
         self._recent.append((self._ep_step, np.asarray(new_frame, self.frame_dtype)))
         self._register_frame(self._ep_step, new_frame)
+        if self._acting_view is not None:
+            self._view_push(new_frame)
         ex = {name: np.asarray((extras or {})[name], np.float32)
               for name in self.extra_shapes}
         self._window.append((obs_idx, action, float(reward),

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.actors.pool import ActorPool
+from apex_tpu.actors.pool import ActorPool, ActorTimingStat
 from apex_tpu.config import ApexConfig
 from apex_tpu.parallel.aggregate import stack_chunk_messages
 from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
@@ -103,6 +103,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # interval > steps-per-call)
     _last_save = 0
     _last_log = 0
+    # actor-plane observability: latest ActorTimingStat per worker (the
+    # vector workers' periodic policy-wait/env-step/drain splits) and the
+    # cumulative count of stats workers dropped on a full stat queue
+    actor_timing: dict | None = None
+    stat_drops = 0
 
     # -- param plane -------------------------------------------------------
 
@@ -190,6 +195,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
         cfg = self.cfg
         pool = self.pool
         target_steps = self.steps_rate.total + total_steps
+        if self.actor_timing is None:
+            self.actor_timing = {}
         from apex_tpu.utils.profiling import DispatchGapTimer
         gap = self._dispatch_gap = DispatchGapTimer()
         pipeline = None
@@ -347,6 +354,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     last_health = now
 
                 for stat in pool.poll_stats():
+                    self.stat_drops += getattr(stat, "dropped_stats", 0)
+                    if isinstance(stat, ActorTimingStat):
+                        self.actor_timing[stat.actor_id] = stat
+                        self.log.scalars(
+                            {"actor_fps": stat.frames_per_sec,
+                             "actor_policy_wait_frac":
+                                 stat.policy_wait_frac,
+                             "actor_env_step_frac": stat.env_step_frac,
+                             "actor_drain_frac": stat.drain_frac,
+                             "actor_dispatch_gap_ms_p50":
+                                 stat.dispatch_gap_ms_p50}, steps)
+                        continue
                     self.log.scalars(
                         {"episode_reward": stat.reward,
                          "episode_length": stat.length,
@@ -381,6 +400,31 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # this run; the NEXT call then starts fresh
                 stop.clear()
         return self
+
+    def actor_plane(self) -> dict | None:
+        """Aggregate actor-plane view from the latest per-worker
+        :class:`~apex_tpu.actors.pool.ActorTimingStat`\\ s (the e2e bench
+        surfaces this next to ``env_frames_per_sec``), or None when no
+        worker has reported yet (scalar fleets / timing_interval=0)."""
+        if not self.actor_timing:
+            return None
+        ts = list(self.actor_timing.values())
+
+        def mean(vals):
+            return round(float(np.mean(vals)), 4)
+
+        return {
+            "workers_reporting": len(ts),
+            "double_buffer": all(t.double_buffer for t in ts),
+            "frames_per_sec_sum":
+                round(sum(t.frames_per_sec for t in ts), 1),
+            "policy_wait_frac": mean([t.policy_wait_frac for t in ts]),
+            "env_step_frac": mean([t.env_step_frac for t in ts]),
+            "drain_frac": mean([t.drain_frac for t in ts]),
+            "dispatch_gap_ms_p50":
+                mean([t.dispatch_gap_ms_p50 for t in ts]),
+            "stat_drops": self.stat_drops,
+        }
 
     def _beta(self, ingested: int | None = None) -> float:
         n = self.ingested if ingested is None else ingested

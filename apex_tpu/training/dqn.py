@@ -165,10 +165,11 @@ class DQNTrainer(CheckpointableTrainer):
             actions, q = self._policy(self.train_state.params,
                                       obs_np[None], jnp.float32(eps), act_key)
             action = int(actions[0])
-            q_np = np.asarray(q[0])
 
             next_obs, reward, terminated, truncated, _ = self.env.step(action)
             done = terminated or truncated
+            # q materializes at its use site, after the env step (J008)
+            q_np = np.asarray(q[0])
             self.accumulator.add(obs_np, action, float(reward), q_np,
                                  terminated=bool(terminated),
                                  truncated=bool(truncated),

@@ -62,6 +62,46 @@ def mfu(flops: float | None, calls_per_sec: float,
     return flops * calls_per_sec / peak_flops
 
 
+class PhaseTimer:
+    """Named wall-time phase accounting for a host loop (the actor-plane
+    counterpart of :class:`DispatchGapTimer`): callers wrap each phase of a
+    step — policy-wait, env-step, chunk drain — and :meth:`window` reports
+    what fraction of the elapsed wall each phase consumed since the last
+    reset.  Fractions need not sum to 1; the remainder is unattributed
+    host time (param polls, Python bookkeeping).
+
+    Pure host timing — never touches the device, so it is safe on the hot
+    loop.
+    """
+
+    def __init__(self):
+        self._acc: dict[str, float] = {}
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t)
+
+    def add(self, name: str, seconds: float) -> None:
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    def window(self, reset: bool = True) -> dict:
+        """``{"wall_s", "fracs": {name: frac}}`` over the window since
+        construction or the last resetting call."""
+        now = time.perf_counter()
+        wall = max(now - self._t0, 1e-9)
+        out = {"wall_s": wall,
+               "fracs": {k: v / wall for k, v in self._acc.items()}}
+        if reset:
+            self._acc = {k: 0.0 for k in self._acc}
+            self._t0 = now
+        return out
+
+
 class DispatchGapTimer:
     """Host-side dispatch-gap accounting for async-dispatch hot loops.
 

@@ -716,6 +716,173 @@ def bench_ingest_pipeline_dp() -> dict:
     return {"error": (p.stderr or p.stdout or "dp child: no output")[-400:]}
 
 
+# -- part 1d: actor-plane double-buffer A/B ---------------------------------
+
+ACTOR_AB_TIMEOUT = float(os.environ.get("BENCH_ACTOR_AB_TIMEOUT", 300.0))
+
+
+def _burn_cpu(n: int = 4_000_000) -> int:
+    """Fixed CPU burn for the effective-core probe (module-level: spawn
+    contexts pickle the target by reference)."""
+    x = 0
+    for i in range(n):
+        x += i * i
+    return x
+
+
+def _burn_child(n: int, barrier, out_q) -> None:
+    """Probe child: sync on the barrier (so both children burn
+    CONCURRENTLY and spawn startup stays out of the measurement), then
+    time its own burn."""
+    barrier.wait()
+    t0 = time.perf_counter()
+    _burn_cpu(n)
+    out_q.put(time.perf_counter() - t0)
+
+
+def _effective_cores(samples: int = 2) -> float:
+    """Measured parallel CPU capacity (2-process scaling of a fixed burn,
+    barrier-synced, per-child timed).  The double-buffer A/B is a PURE
+    SCHEDULING experiment (both modes run bit-identical work — the parity
+    pin demands it), so its ceiling is exactly this number: a 1-core
+    cgroup shows ~1.0x by physics, a 2-core actor host can show the real
+    overlap win.  Recorded so the artifact is interpretable across
+    boxes."""
+    import multiprocessing as mp
+    import queue as queue_lib
+
+    n = 4_000_000
+    ctx = mp.get_context("spawn")
+    ones = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        _burn_cpu(n)
+        ones.append(time.perf_counter() - t0)
+    ratios = []
+    for _ in range(samples):
+        barrier = ctx.Barrier(3)
+        out_q = ctx.Queue()
+        ps = [ctx.Process(target=_burn_child, args=(n, barrier, out_q),
+                          daemon=True) for _ in range(2)]
+        try:
+            for p in ps:
+                p.start()
+            # a child that dies before the barrier (spawn pickling only
+            # resolves _burn_child when this module is importable under
+            # its real name) must never hang the probe: bounded waits,
+            # 0.0 = probe unavailable
+            barrier.wait(timeout=30)
+            times = [out_q.get(timeout=60) for _ in range(2)]
+        except (threading.BrokenBarrierError, queue_lib.Empty):
+            return 0.0
+        finally:
+            for p in ps:
+                if p.is_alive():
+                    p.terminate()
+                p.join(timeout=10)
+        ratios.append(2 * min(ones) / max(max(times), 1e-9))
+    return round(max(ratios), 2)
+
+
+def bench_actor_plane() -> dict:
+    """Part 1d: the vector-actor hot loop, double-buffer on vs off, same
+    fixed-seed env batch and key chain (the modes are bit-identical per
+    slot — tests/test_vector.py pins it — so frames/s is the ONLY thing
+    the knob changes).  Two geometries: the toy CartPole MLP (dispatch-
+    overhead regime) and the 84x84x4 pixel conv (inference-bound regime,
+    the flagship shape).  Reports per-mode frames/s and the PhaseTimer
+    overlap split (policy-wait / env-step fractions), plus the box's
+    measured effective cores — the scheduling win's hard ceiling."""
+    import jax
+    import numpy as np
+
+    from apex_tpu.actors.pool import actor_epsilons
+    from apex_tpu.actors.vector import VectorDQNWorkerFamily
+    from apex_tpu.config import ApexConfig, ActorConfig, EnvConfig
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.apex import dqn_env_specs
+    from apex_tpu.training.state import create_train_state
+
+    steps = int(os.environ.get("BENCH_ACTOR_STEPS", 60))
+    reps = int(os.environ.get("BENCH_ACTOR_REPS", 3))
+    warm = 6
+
+    def make_family(env_cfg: EnvConfig, n_envs: int, double_buffer: bool):
+        cfg = ApexConfig(env=env_cfg,
+                         actor=ActorConfig(n_actors=1,
+                                           n_envs_per_actor=n_envs,
+                                           double_buffer=double_buffer))
+        model_spec, frame_shape, frame_dtype, frame_stack = \
+            dqn_env_specs(cfg)
+        fam = VectorDQNWorkerFamily(
+            cfg, model_spec,
+            seeds=[cfg.env.seed + 1000 * (s + 1) for s in range(n_envs)],
+            slot_ids=list(range(n_envs)),
+            epsilons=actor_epsilons(n_envs), chunk_transitions=64)
+        model = DuelingDQN(**model_spec)
+        stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+        ts = create_train_state(model, make_optimizer(), jax.random.key(0),
+                                np.zeros((1,) + stacked, frame_dtype))
+        fam.reset_all()
+        return fam, ts.params
+
+    def timed_window(fam, params, key, n_steps: int):
+        fam.phase.window(reset=True)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+            fam.poll_msgs()
+        dt = time.perf_counter() - t0
+        w = fam.phase.window(reset=False)
+        return key, {
+            "frames_per_sec": round(n_steps * fam.n_envs / dt, 1),
+            "policy_wait_frac":
+                round(w["fracs"].get("policy_wait", 0.0), 3),
+            "env_step_frac": round(w["fracs"].get("env_step", 0.0), 3),
+            "dispatch_gap_ms_p50":
+                round(fam.gap.snapshot()["dispatch_gap_ms_p50"], 3),
+            "seconds": round(dt, 2)}
+
+    def ab(env_cfg: EnvConfig, n_envs: int, n_steps: int) -> dict:
+        fams = {mode: make_family(env_cfg, n_envs, mode)
+                for mode in (False, True)}
+        keys = {mode: jax.random.key(7) for mode in fams}
+        for mode, (fam, params) in fams.items():     # compile + warm
+            for _ in range(warm):
+                keys[mode], k = jax.random.split(keys[mode])
+                fam.step_all(params, k)
+                fam.poll_msgs()
+        runs: dict[bool, list] = {False: [], True: []}
+        for _ in range(reps):         # alternate modes so scheduler drift
+            for mode in (False, True):     # hits both; best-of-reps damps
+                fam, params = fams[mode]   # 1-core noise (cf. part 1b)
+                keys[mode], r = timed_window(fam, params, keys[mode],
+                                             n_steps)
+                runs[mode].append(r)
+        best = {mode: max(rs, key=lambda r: r["frames_per_sec"])
+                for mode, rs in runs.items()}
+        for mode, rs in runs.items():
+            best[mode]["reps"] = [r["frames_per_sec"] for r in rs]
+        for fam, _ in fams.values():
+            fam.close()
+        return {
+            "n_envs": n_envs, "vector_steps": n_steps,
+            "off": best[False], "on": best[True],
+            "speedup": (round(best[True]["frames_per_sec"]
+                              / best[False]["frames_per_sec"], 3)
+                        if best[False]["frames_per_sec"] else None)}
+
+    toy = EnvConfig(env_id="ApexCartPole-v0", frame_stack=1,
+                    clip_rewards=False, episodic_life=False)
+    pixel = EnvConfig(env_id="ApexCatch-v0", frame_stack=FRAME_STACK,
+                      clip_rewards=False, episodic_life=False)
+    return {"effective_cores": _effective_cores(),
+            "toy": ab(toy, 32, steps * 4),
+            "pixel": ab(pixel, 16, steps)}
+
+
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
 def bench_end_to_end(e2e_seconds: float) -> dict:
@@ -818,6 +985,7 @@ def bench_end_to_end(e2e_seconds: float) -> dict:
             "data_plane": data_plane,
             "scan_steps": scan_steps,
             "scan_dispatches": trainer.scan_dispatches,
+            "actor_plane": trainer.actor_plane(),
             "ingest_pipeline": trainer._pipeline_last_stats,
             "dispatch_gap": (trainer._dispatch_gap.snapshot()
                              if trainer._dispatch_gap is not None else None),
@@ -883,6 +1051,16 @@ def main() -> None:
         _arm("ingest_pipeline_dp", DP_PIPE_TIMEOUT + 30)
         with _print_lock:
             RESULT["ingest_pipeline_dp"] = bench_ingest_pipeline_dp()
+
+    if os.environ.get("BENCH_SKIP_ACTOR_AB", "0") != "1":
+        # part 1d: the actor-plane scheduling A/B (double-buffer on/off)
+        _arm("actor_plane_ab", ACTOR_AB_TIMEOUT)
+        try:
+            ab = bench_actor_plane()
+        except Exception as exc:   # the headline metric survives regardless
+            ab = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["actor_plane_ab"] = ab
 
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU

@@ -481,6 +481,99 @@ def test_j007_silent_on_unrelated_attr():
         """, "J007")
 
 
+# -- J008: jitted result materialized before its use site -------------------
+
+def test_j008_fires_on_eager_materialize_in_step_loop():
+    """The exact pre-PR-4 actor anti-pattern: dispatch, block on
+    np.asarray immediately, then do unrelated host work before the slot
+    loop consumes the values — the sync serializes dispatch against work
+    it could overlap (actors/vector.py removed this shape)."""
+    assert fires("""
+        import jax
+        import numpy as np
+        class Fam:
+            def __init__(self, fn):
+                self.policy = jax.jit(fn)
+            def step_all(self, params, stacks, eps, key):
+                out = self.policy(params, stacks, eps, key)
+                actions = np.asarray(out[0])
+                stats = []
+                bookkeeping(stats)
+                for i in range(len(stats)):
+                    step_env(i, actions[i])
+                return stats
+        """, "J008")
+
+
+def test_j008_fires_on_device_get_inside_loop():
+    assert fires("""
+        import jax
+        step = jax.jit(fused)
+        def drive(ts, chunks):
+            for chunk in chunks:
+                m = step(ts, chunk)
+                host = jax.device_get(m)
+                other_work(chunk)
+                log(host)
+        """, "J008")
+
+
+def test_j008_silent_when_materialized_at_use_site():
+    """Deferring the sync to immediately before the consuming loop is the
+    sanctioned shape (the double-buffered step materializes each group
+    right before stepping that group's envs)."""
+    assert not fires("""
+        import jax
+        import numpy as np
+        class Fam:
+            def __init__(self, fn):
+                self.policy = jax.jit(fn)
+            def step_all(self, params, stacks, eps, key):
+                out = self.policy(params, stacks, eps, key)
+                stats = []
+                bookkeeping(stats)
+                actions = np.asarray(out[0])
+                for i in range(len(stats)):
+                    step_env(i, actions[i])
+                return stats
+        """, "J008")
+
+
+def test_j008_silent_under_phase_timer_scope():
+    """A deliberate, *accounted* wait (PhaseTimer.phase) is exempt — the
+    actor families time their policy-wait there on purpose."""
+    assert not fires("""
+        import jax
+        import numpy as np
+        class Fam:
+            def __init__(self, fn, timer):
+                self.policy = jax.jit(fn)
+                self.phase = timer
+            def step_all(self, params, stacks, eps, key):
+                out = self.policy(params, stacks, eps, key)
+                with self.phase.phase("policy_wait"):
+                    actions = np.asarray(out[0])
+                bookkeeping()
+                for a in actions:
+                    step_env(a)
+        """, "J008")
+
+
+def test_j008_silent_on_plain_numpy_asarray():
+    """np.asarray over host values (no jit dispatch in sight) is ordinary
+    numpy code, not a device sync."""
+    assert not fires("""
+        import numpy as np
+        def collect(rows):
+            arr = np.asarray(rows)
+            out = []
+            normalize(out)
+            for r in arr:
+                out.append(r)
+            return out
+        """, "J008")
+
+
 # -- C001: process start after a live thread --------------------------------
 
 def test_c001_fires_on_fork_after_thread():

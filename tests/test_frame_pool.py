@@ -331,6 +331,65 @@ def _valid_chunk(pool, k, kf, rng):
         next_ref=np.zeros((k, s), np.int32))
 
 
+@pytest.mark.parametrize("frame_shape,stack", [((5, 5, 1), 3), ((4,), 1)])
+def test_view_backed_acting_stack_matches_copy_path(frame_shape, stack):
+    """bind_acting_view: the in-place acting stack is bit-identical to the
+    concatenate path at EVERY step — across episode starts (reset-frame
+    padding), mid-episode rolls, chunk-boundary flush carries, and
+    auto-resets — and the emitted chunks are unchanged."""
+    rng = np.random.default_rng(0)
+
+    def build():
+        return FrameChunkBuilder(2, 0.9, stack, frame_shape,
+                                 chunk_transitions=8, frame_margin=4,
+                                 frame_dtype=np.uint8)
+
+    copy_b = build()
+    view_b = build()
+    stacked = view_b.stacked_shape()
+    buf = np.zeros((1,) + stacked, np.uint8)    # a vector family's row
+    view_b.bind_acting_view(buf[0])
+
+    chunks_copy, chunks_view = [], []
+    for _ in range(4):                           # episodes
+        f0 = rng.integers(0, 255, frame_shape).astype(np.uint8)
+        copy_b.begin_episode(f0)
+        view_b.begin_episode(f0)
+        np.testing.assert_array_equal(view_b.current_stack(),
+                                      copy_b.current_stack())
+        assert np.shares_memory(view_b.current_stack(), buf)   # no copy
+        ep_len = int(rng.integers(3, 30))
+        for t in range(ep_len):
+            f = rng.integers(0, 255, frame_shape).astype(np.uint8)
+            args = (int(rng.integers(0, 3)), float(rng.normal()),
+                    rng.normal(size=4).astype(np.float32), f,
+                    t == ep_len - 1, False)
+            copy_b.add_step(*args)
+            view_b.add_step(*args)
+            if t < ep_len - 1:       # stack undefined after episode end
+                np.testing.assert_array_equal(view_b.current_stack(),
+                                              copy_b.current_stack())
+        chunks_copy.extend(copy_b.poll())
+        chunks_view.extend(view_b.poll())
+
+    chunks_copy.extend(copy_b.force_flush())
+    chunks_view.extend(view_b.force_flush())
+    assert len(chunks_copy) == len(chunks_view) > 0
+    for ca, cb in zip(chunks_copy, chunks_view):
+        for k in ca:
+            np.testing.assert_array_equal(np.asarray(ca[k]),
+                                          np.asarray(cb[k]))
+
+
+def test_bind_acting_view_validates_shape_and_dtype():
+    b = FrameChunkBuilder(2, 0.9, 3, (5, 5, 1), chunk_transitions=8)
+    with pytest.raises(ValueError, match="acting view"):
+        b.bind_acting_view(np.zeros((5, 5, 2), np.uint8))   # wrong shape
+    with pytest.raises(ValueError, match="acting view"):
+        b.bind_acting_view(np.zeros((5, 5, 3), np.float32))  # wrong dtype
+    b.bind_acting_view(np.zeros((5, 5, 3), np.uint8))
+
+
 def test_add_rejects_oversized_and_misshapen_chunks():
     pool = FramePoolReplay(capacity=8, frame_capacity=16,
                            frame_shape=SHAPE, frame_stack=2)

@@ -276,7 +276,6 @@ def test_sharded_is_weights_correct_under_skew(key):
         per_chip, mesh=mesh, in_specs=(P("dp"), P("dp")),
         out_specs=(P("dp"), P("dp")), check_vma=False))
     w, idx = sample(rs, sl.device_keys(jax.random.key(3)))
-    w, idx = np.asarray(w), np.asarray(idx)       # (8, 8) each
 
     trees = np.asarray(rs.sum_tree)               # (8, 2*cap)
     mins = np.asarray(rs.min_tree)
@@ -288,6 +287,7 @@ def test_sharded_is_weights_correct_under_skew(key):
     assert shard_total.max() / shard_total.mean() > 1.5
     # globally consistent normalizer = pmax of per-shard max weights
     max_w = ((shard_min / shard_total * n_shard) ** (-0.4)).max()
+    w, idx = np.asarray(w), np.asarray(idx)       # (8, 8) each
     for s in range(8):
         leaves = trees[s, cap + idx[s]]
         expect = (leaves / shard_total[s] * n_shard) ** (-0.4) / max_w

@@ -96,6 +96,278 @@ def test_vector_epsilons_span_global_ladder():
     assert (np.diff(all_eps) < 0).all()   # monotone across the whole fleet
 
 
+def _chunk_msgs_equal(a: list[dict], b: list[dict]) -> None:
+    assert len(a) == len(b)
+    for ma, mb in zip(a, b):
+        assert ma["n_trans"] == mb["n_trans"]
+        np.testing.assert_array_equal(ma["priorities"], mb["priorities"])
+        pa, pb = ma["payload"], mb["payload"]
+        assert set(pa) == set(pb)
+        for k in pa:
+            np.testing.assert_array_equal(np.asarray(pa[k]),
+                                          np.asarray(pb[k]),
+                                          err_msg=f"payload[{k}] diverged")
+
+
+def _drive(fam, params, n_steps, seed=1):
+    """Fixed key chain through n_steps vector steps; returns
+    (stats, chunk messages incl. flush)."""
+    fam.reset_all()
+    key = jax.random.key(seed)
+    stats, msgs = [], []
+    for _ in range(n_steps):
+        key, k = jax.random.split(key)
+        stats.extend(fam.step_all(params, k))
+        msgs.extend(fam.poll_msgs())
+    msgs.extend(m for b in fam.builders
+                for m in ({"payload": c, "priorities": c.pop("priorities"),
+                           "n_trans": int(c["n_trans"])}
+                          for c in b.force_flush()))
+    fam.close()
+    return stats, msgs
+
+
+@pytest.mark.parametrize("n_envs", [2, 5])
+def test_double_buffer_bit_parity_with_serial(n_envs):
+    """The tentpole acceptance pin: double-buffered and serial vector
+    acting are BIT-IDENTICAL per slot — same actions, same chunks, same
+    priorities — because both modes run the policy per half-group with
+    fold_in(step_key, group) subkeys; only the dispatch/step interleaving
+    differs.  Odd n_envs exercises uneven groups."""
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.state import create_train_state
+
+    runs = {}
+    for db in (True, False):
+        cfg = small_test_config()
+        cfg = cfg.replace(actor=dataclasses.replace(cfg.actor,
+                                                    double_buffer=db))
+        model_spec, frame_shape, frame_dtype, _ = dqn_env_specs(cfg)
+        ladder = actor_epsilons(n_envs)
+        fam = VectorDQNWorkerFamily(
+            cfg, model_spec, seeds=[100 + i for i in range(n_envs)],
+            slot_ids=list(range(n_envs)), epsilons=ladder,
+            chunk_transitions=16)
+        assert fam.double_buffer == db
+        assert len(fam.groups) == 2
+        model = DuelingDQN(**model_spec)
+        ts = create_train_state(
+            model, make_optimizer(), jax.random.key(0),
+            np.zeros((1,) + frame_shape, frame_dtype))
+        runs[db] = _drive(fam, ts.params, 120)
+
+    stats_db, msgs_db = runs[True]
+    stats_serial, msgs_serial = runs[False]
+    assert [(s.actor_id, s.reward, s.length) for s in stats_db] \
+        == [(s.actor_id, s.reward, s.length) for s in stats_serial]
+    assert stats_db, "no episodes ended: the pin never exercised resets"
+    _chunk_msgs_equal(msgs_db, msgs_serial)
+
+
+def test_scalar_fleet_and_vector_worker_slot_parity():
+    """The worker_slots contract: a fleet of scalar workers on the same
+    global slots and one vector worker produce IDENTICAL per-slot epsilon
+    ladders and identical chunk-message shapes through
+    drain_builder_chunks (same schema, same K/ref/frame geometry)."""
+    from apex_tpu.actors.pool import DQNWorkerFamily, drain_builder_chunks
+    from apex_tpu.actors.vector import worker_slots
+
+    b = 3
+    cfg = small_test_config()
+    cfg = cfg.replace(actor=dataclasses.replace(
+        cfg.actor, n_actors=2, n_envs_per_actor=b))
+    model_spec, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    slot_ids, seeds, eps = worker_slots(cfg, actor_id=0)
+
+    # identical epsilon ladder: the vector worker's slots ARE the scalar
+    # fleet's global ladder entries (and scalar seeds match slot seeds)
+    total = cfg.actor.n_actors * b
+    ladder = actor_epsilons(total, cfg.actor.eps_base, cfg.actor.eps_alpha)
+    np.testing.assert_array_equal(eps, ladder[slot_ids])
+    assert seeds == [cfg.env.seed + 1000 * (s + 1) for s in slot_ids]
+
+    vec = VectorDQNWorkerFamily(cfg, model_spec, seeds=seeds,
+                                slot_ids=slot_ids, epsilons=eps,
+                                chunk_transitions=16)
+    scalars = [DQNWorkerFamily(cfg, model_spec, seed=seeds[i],
+                               chunk_transitions=16) for i in range(b)]
+
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.state import create_train_state
+    model = DuelingDQN(**model_spec)
+    ts = create_train_state(model, make_optimizer(), jax.random.key(0),
+                            np.zeros((1,) + frame_shape, frame_dtype))
+
+    _, vec_msgs = _drive(vec, ts.params, 100)
+
+    scalar_msgs = []
+    for i, fam in enumerate(scalars):
+        key = jax.random.key(1000 + i)
+        obs, _ = fam.env.reset(seed=fam.seed)
+        fam.begin_episode(obs)
+        for _ in range(100):
+            key, k = jax.random.split(key)
+            obs, _r, term, trunc = fam.step(ts.params, obs,
+                                            float(eps[i]), k)
+            scalar_msgs.extend(fam.poll_msgs())
+            if term or trunc:
+                obs, _ = fam.env.reset()
+                fam.begin_episode(obs)
+        scalar_msgs.extend(
+            {"payload": c, "priorities": c.pop("priorities"),
+             "n_trans": int(c["n_trans"])}
+            for c in fam.builder.force_flush())
+        fam.env.close()
+
+    assert vec_msgs and scalar_msgs
+    ref = scalar_msgs[0]["payload"]
+    for msg in vec_msgs + scalar_msgs:
+        p = msg["payload"]
+        assert set(p) == set(ref), "chunk-message schema diverged"
+        for k in ref:
+            assert p[k].shape == ref[k].shape, f"{k} shape diverged"
+            assert p[k].dtype == ref[k].dtype, f"{k} dtype diverged"
+        assert msg["priorities"].shape == (16,)
+
+
+def test_vector_slot_arity_value_error():
+    """The slot-arity guard survives `python -O` and names the config
+    knobs that derive the three lists."""
+    cfg = small_test_config()
+    model_spec, *_ = dqn_env_specs(cfg)
+    with pytest.raises(ValueError, match="n_envs_per_actor"):
+        VectorDQNWorkerFamily(cfg, model_spec, seeds=[1, 2, 3],
+                              slot_ids=[0, 1], epsilons=[0.4, 0.3, 0.2],
+                              chunk_transitions=16)
+
+
+def test_vector_worker_loop_counts_dropped_stats_and_emits_timing():
+    """A full stat queue no longer loses episode stats SILENTLY: the next
+    successful put carries the number dropped since the last success.  The
+    loop also emits a periodic ActorTimingStat with the policy-wait /
+    env-step / drain split."""
+    import queue
+    import threading
+
+    from apex_tpu.actors.pool import ActorTimingStat, EpisodeStat
+    from apex_tpu.actors.vector import vector_worker_loop
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.state import create_train_state
+
+    cfg = small_test_config()
+    cfg = cfg.replace(actor=dataclasses.replace(cfg.actor,
+                                                timing_interval=8))
+    model_spec, frame_shape, frame_dtype, _ = dqn_env_specs(cfg)
+    n_envs = 3
+    fam = VectorDQNWorkerFamily(
+        cfg, model_spec, seeds=[100 + i for i in range(n_envs)],
+        slot_ids=list(range(n_envs)), epsilons=actor_epsilons(n_envs),
+        chunk_transitions=16)
+    model = DuelingDQN(**model_spec)
+    ts = create_train_state(model, make_optimizer(), jax.random.key(0),
+                            np.zeros((1,) + frame_shape, frame_dtype))
+
+    chunk_queue: queue.Queue = queue.Queue()
+    param_queue: queue.Queue = queue.Queue()
+    stat_queue: queue.Queue = queue.Queue(maxsize=1)   # force drops
+    stop = threading.Event()
+    param_queue.put((1, ts.params))
+    t = threading.Thread(target=vector_worker_loop,
+                         args=(0, cfg, fam, chunk_queue, param_queue,
+                               stat_queue, stop), daemon=True)
+    t.start()
+
+    import time
+    stats = []
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        try:
+            stats.append(stat_queue.get(timeout=0.5))
+        except queue.Empty:
+            continue
+        if (any(s.dropped_stats > 0 for s in stats)
+                and any(isinstance(s, ActorTimingStat) for s in stats)):
+            break
+    stop.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+
+    assert any(isinstance(s, EpisodeStat) and s.dropped_stats > 0
+               for s in stats), "drops never surfaced on a carried stat"
+    timing = [s for s in stats if isinstance(s, ActorTimingStat)]
+    assert timing, "no periodic ActorTimingStat arrived"
+    ts0 = timing[0]
+    assert ts0.vector_steps == 8 and ts0.frames_per_sec > 0
+    assert ts0.double_buffer
+    for frac in (ts0.policy_wait_frac, ts0.env_step_frac, ts0.drain_frac):
+        assert 0.0 <= frac <= 1.0
+    assert ts0.policy_wait_frac + ts0.env_step_frac + ts0.drain_frac <= 1.0
+
+
+def test_trainer_drains_actor_timing_stats_and_aggregates():
+    """The learner's stats drain dispatches on type: ActorTimingStat lands
+    in trainer.actor_timing (+ scalar logs), EpisodeStat keeps its episode
+    semantics, and both contribute their carried drop counts; actor_plane()
+    aggregates across workers for the e2e bench."""
+    from apex_tpu.actors.pool import ActorTimingStat, EpisodeStat
+    from apex_tpu.training.apex import ApexTrainer
+
+    class OneShotPool:
+        procs: list = []
+
+        def __init__(self, stats):
+            self._stats = list(stats)
+
+        def start(self):
+            pass
+
+        def cleanup(self):
+            pass
+
+        def publish_params(self, version, params):
+            pass
+
+        def poll_chunks(self, max_chunks, timeout=0.0):
+            return []
+
+        def poll_stats(self):
+            out, self._stats = self._stats, []
+            return out
+
+    stats = [
+        ActorTimingStat(actor_id=0, frames_per_sec=100.0,
+                        policy_wait_frac=0.5, env_step_frac=0.3,
+                        drain_frac=0.1, dispatch_gap_ms_p50=2.5,
+                        vector_steps=256, double_buffer=True,
+                        dropped_stats=3),
+        ActorTimingStat(actor_id=1, frames_per_sec=50.0,
+                        policy_wait_frac=0.3, env_step_frac=0.5,
+                        drain_frac=0.1, dispatch_gap_ms_p50=1.5,
+                        vector_steps=256, double_buffer=True),
+        EpisodeStat(2, 1.0, 5, dropped_stats=2),
+    ]
+    trainer = ApexTrainer(small_test_config(), pool=OneShotPool(stats),
+                          respawn_workers=False)
+    assert trainer.actor_plane() is None     # nothing reported yet
+    trainer.train(total_steps=1, max_seconds=1.0, log_every=10 ** 9)
+
+    assert set(trainer.actor_timing) == {0, 1}
+    assert trainer.stat_drops == 5
+    ap = trainer.actor_plane()
+    assert ap["workers_reporting"] == 2
+    assert ap["double_buffer"] is True
+    assert ap["frames_per_sec_sum"] == 150.0
+    assert ap["policy_wait_frac"] == pytest.approx(0.4)
+    assert ap["stat_drops"] == 5
+    # episode stats kept their channel
+    rewards = [v for _, v in trainer.log.history.get(
+        "learner/episode_reward", [])]
+    assert rewards == [1.0]
+
+
 @pytest.mark.slow
 def test_apex_trainer_with_vector_actors():
     """End-to-end: ApexTrainer drives vector workers (1 process x 4 envs)
