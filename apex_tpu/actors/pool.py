@@ -150,12 +150,33 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
 
     import jax
 
+    from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+
     key = jax.random.key(family.seed)
     env = family.env
+    # fleet liveness: periodic Heartbeats on the stat channel — the
+    # in-host trainer and the socket learner's registry consume the same
+    # message (the socket adapters expose wire counters / park state)
+    beat = HeartbeatEmitter(
+        f"actor-{actor_id}", role="actor",
+        interval_s=cfg.comms.heartbeat_interval_s,
+        counters_fn=getattr(chunk_queue, "wire_counters", None),
+        park_fn=getattr(param_queue, "park_state", None))
+
+    def _maybe_beat(version: int) -> None:
+        hb = beat.maybe_beat(version)
+        if hb is not None:
+            try:
+                stat_queue.put_nowait(hb)
+            except queue_lib.Full:
+                pass                # droppable telemetry, like every stat
+
+    version = 0
     while True:                                  # block for first publish,
         if stop_event.is_set():                  # but stay interruptible
             env.close()
             return
+        _maybe_beat(version)
         try:
             version, params = param_queue.get(timeout=0.5)
             break
@@ -193,8 +214,11 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         total_steps += 1
         ep_reward += reward
         ep_len += 1
+        beat.tick()
+        _maybe_beat(version)
 
         for msg in family.poll_msgs():
+            beat.note_chunk()
             chunk_queue.put(("chunk", actor_id, msg))     # blocks when full
         if terminated or truncated:
             try:

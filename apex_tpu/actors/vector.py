@@ -341,12 +341,25 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     :class:`~apex_tpu.actors.pool.ActorTimingStat`)."""
     import jax
 
+    from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+
     key = jax.random.key(family.seeds[0])
+    beat = HeartbeatEmitter(
+        f"actor-{actor_id}", role="actor",
+        interval_s=cfg.comms.heartbeat_interval_s,
+        counters_fn=getattr(chunk_queue, "wire_counters", None),
+        park_fn=getattr(param_queue, "park_state", None))
     version, params = 0, None
     while True:                                  # block for first publish
         if stop_event.is_set():
             family.close()
             return
+        hb = beat.maybe_beat(version)
+        if hb is not None:
+            try:
+                stat_queue.put_nowait(hb)
+            except queue_lib.Full:
+                pass
         try:
             version, params = param_queue.get(timeout=0.5)
             break
@@ -378,6 +391,10 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         key, akey = jax.random.split(key)
         stats = list(family.step_all(params, akey))
         vec_steps += 1
+        beat.tick(family.n_envs)
+        hb = beat.maybe_beat(version)
+        if hb is not None:
+            stats.append(hb)      # rides the stat put loop like every stat
         if timing_every and vec_steps % timing_every == 0:
             stats.append(_timing_stat(actor_id, family, timing_every))
         for stat in stats:
@@ -392,6 +409,7 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
 
         with family.phase.phase("drain"):
             for msg in family.poll_msgs():
+                beat.note_chunk()
                 chunk_queue.put(("chunk", actor_id, msg))  # blocks when full
 
     family.close()

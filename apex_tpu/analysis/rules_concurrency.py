@@ -413,3 +413,56 @@ class ShmForeignUnlink(Rule):
                        for s in src_names if s):
                     return True
         return False
+
+
+# -- C005 -------------------------------------------------------------------
+
+
+@register
+class NakedPickleLoads(Rule):
+    id = "C005"
+    name = "naked-pickle-loads"
+    description = ("pickle.loads / pickle.Unpickler outside the allowlisted "
+                   "unpickler module (apex_tpu/runtime/wire.py): a bare "
+                   "unpickle of cross-process bytes is arbitrary code "
+                   "execution on a network/IPC boundary — route through "
+                   "apex_tpu.runtime.wire.restricted_loads")
+
+    #: THE designated unpickler module — the one place a raw Unpickler is
+    #: allowed to exist (it is the thing implementing the allowlist)
+    ALLOWED_SUFFIX = "runtime/wire.py"
+
+    def _is_naked_load(self, node: ast.Call) -> str | None:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            # bare `Unpickler(...)` from `from pickle import Unpickler`
+            if isinstance(f, ast.Name) and f.id == "Unpickler":
+                return "Unpickler"
+            return None
+        root = f.value
+        is_pickle_mod = (isinstance(root, ast.Name)
+                         and root.id in ("pickle", "cPickle"))
+        if f.attr in ("loads", "load") and is_pickle_mod:
+            return f"pickle.{f.attr}"
+        if f.attr == "Unpickler" and is_pickle_mod:
+            return "pickle.Unpickler"
+        return None
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if ctx.path.replace("\\", "/").endswith(self.ALLOWED_SUFFIX):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._is_naked_load(node)
+            if what is None:
+                continue
+            out.append(ctx.finding(
+                self, node,
+                f"{what} outside the allowlisted unpickler module — "
+                f"deserializing cross-process bytes executes arbitrary "
+                f"__reduce__ payloads; use "
+                f"apex_tpu.runtime.wire.restricted_loads (add new message "
+                f"types to its allowlist, don't bypass it)"))
+        return out

@@ -752,6 +752,107 @@ class EagerJitMaterialize(Rule):
             f"PhaseTimer.phase scope)")
 
 
+# -- J009 -------------------------------------------------------------------
+
+
+_QUEUE_NAME_RE = re.compile(r"(queue|_q$|^q$)", re.IGNORECASE)
+
+#: calls that force a HOST value out of a device result — putting one of
+#: these on the queue ships plain numpy/python, which is the point
+_J009_MATERIALIZERS = {"asarray", "array", "device_get", "int", "float",
+                       "bool", "tolist", "item"}
+
+
+@register
+class DeviceArrayOnMpQueue(Rule):
+    id = "J009"
+    name = "device-array-on-mp-queue"
+    description = ("mp.Queue put of a jitted/device result without a host "
+                   "materialize: Queue.put pickles the object, forcing an "
+                   "implicit device->host copy (and a device sync) per "
+                   "chunk inside the worker loop — np.asarray/device_get "
+                   "it once at the producer and ship host data")
+
+    @staticmethod
+    def _queue_receiver(call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("put", "put_nowait")):
+            return False
+        recv = f.value
+        name = None
+        if isinstance(recv, ast.Name):
+            name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            name = recv.attr
+        return bool(name and _QUEUE_NAME_RE.search(name))
+
+    @staticmethod
+    def _materialized(ctx: ModuleContext, name_node: ast.AST,
+                      put: ast.Call) -> bool:
+        """True when the device name is wrapped in a materializer call
+        somewhere between itself and the put() — ``q.put(np.asarray(x))``
+        ships host data and is fine."""
+        for a in ctx.ancestors(name_node):
+            if a is put:
+                return False
+            if isinstance(a, ast.Call):
+                base = call_name(a)
+                if base in _J009_MATERIALIZERS:
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        jit_names = _jit_callable_names(ctx)
+        if not jit_names:
+            return []
+        out = []
+        for fn in ctx.functions:
+            if ctx.in_jitted_scope(fn):
+                continue
+            device_vars: set[str] = set()
+            rematerialized: set[str] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if isinstance(node.value, ast.Call) \
+                        and call_name(node.value) in jit_names:
+                    for t in node.targets:
+                        elts = (t.elts if isinstance(t, (ast.Tuple,
+                                                         ast.List))
+                                else [t])
+                        device_vars.update(e.id for e in elts
+                                           if isinstance(e, ast.Name))
+                elif isinstance(node.value, ast.Call) \
+                        and call_name(node.value) in _J009_MATERIALIZERS:
+                    # `host = np.asarray(dev)` re-binds a host value:
+                    # putting THAT name is fine
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            rematerialized.add(t.id)
+            if not device_vars:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and self._queue_receiver(node)):
+                    continue
+                offenders = [
+                    n for arg in node.args for n in ast.walk(arg)
+                    if isinstance(n, ast.Name) and n.id in device_vars
+                    and n.id not in rematerialized
+                    and not self._materialized(ctx, n, node)]
+                if offenders:
+                    names = ", ".join(sorted({n.id for n in offenders}))
+                    out.append(ctx.finding(
+                        self, node,
+                        f"device result(s) {names} put on an mp queue "
+                        f"without a host materialize — the pickle in "
+                        f"Queue.put forces a device->host copy + sync per "
+                        f"message; np.asarray/device_get at the producer "
+                        f"and ship host data"))
+        return out
+
+
 # -- J005 -------------------------------------------------------------------
 
 

@@ -222,10 +222,28 @@ class CommsConfig:
     max_outstanding_sends: int = 3   # actor credit window (actor.py:110-112)
     max_outstanding_prios: int = 16  # learner->replay window (learner.py:121-127)
     param_hwm: int = 3               # PUB high-water mark (learner.py:60)
+    status_port: int = 52003         # fleet-status REP (--role status)
     # Learner-side decoder threads unpickling chunk payloads off the
     # socket thread — the reference's N recv_batch pullers
     # (learner.py:71-114, count arguments.py:73-74)
     n_recv_batch_procs: int = 4
+    # -- fleet control plane (apex_tpu/fleet) ------------------------------
+    # Every role beats on the stat channel at this cadence; the learner's
+    # FleetRegistry drives the JOINING -> ALIVE -> SUSPECT -> DEAD machine
+    # from the thresholds below.  dead_after_s must comfortably exceed
+    # suspect_after_s, and suspect_after_s the beat interval, or healthy
+    # peers flap under ordinary queue backpressure.
+    heartbeat_interval_s: float = 2.0
+    suspect_after_s: float = 6.0
+    dead_after_s: float = 15.0
+    # Actor/evaluator park threshold: no param publish for this long means
+    # the learner is gone (a live learner republishes at least every
+    # ~10 * publish_min_seconds ~ 2s) — stop stepping, keep env + builder
+    # state, and retry the barrier/param race with jittered backoff.
+    park_after_s: float = 10.0
+    rejoin_backoff_s: float = 1.0    # first retry delay (doubles per miss)
+    rejoin_backoff_max_s: float = 8.0
+    rejoin_attempt_s: float = 5.0    # per-attempt barrier/param race window
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,26 @@
+"""Fleet control plane: heartbeats, membership, park-and-rejoin, chaos.
+
+Ape-X's premise is a fleet of hundreds of actor processes feeding one
+learner; at that scale role death is routine, not exceptional.  This
+package is the supervision layer the socket runtime
+(:mod:`apex_tpu.runtime`) was missing:
+
+* :mod:`~apex_tpu.fleet.heartbeat` — the periodic liveness message every
+  role ships on the stat channel it already has.
+* :mod:`~apex_tpu.fleet.registry` — the learner-side membership machine
+  (JOINING → ALIVE → SUSPECT → DEAD), the ``fleet_*`` scalars, and the
+  ``--role status`` snapshot surface.
+* :mod:`~apex_tpu.fleet.park` — actor/evaluator staleness detection and
+  the jittered-backoff rejoin race against a respawned learner's barrier.
+* :mod:`~apex_tpu.fleet.chaos` — seeded deterministic fault schedules
+  (kills, drops, delays, stalls) injected through transport wrappers.
+* :mod:`~apex_tpu.fleet.supervise` — the rate-limited host supervisor the
+  deploy bootstraps launch roles under.
+"""
+
+from apex_tpu.fleet.heartbeat import Heartbeat, HeartbeatEmitter
+from apex_tpu.fleet.registry import (FleetRegistry, FleetStatusServer,
+                                     format_fleet_table, status_request)
+
+__all__ = ["Heartbeat", "HeartbeatEmitter", "FleetRegistry",
+           "FleetStatusServer", "format_fleet_table", "status_request"]

@@ -1,0 +1,191 @@
+"""Deterministic fault injection for the multi-host runtime.
+
+A control plane that has never watched its fleet die is decoration.  This
+module turns a seed + a compact spec into a REPLAYABLE fault schedule and
+injects it through thin wrappers around the two transport hot spots —
+chunk sends (actor side) and param publishes (learner side) — so the same
+``CHAOS_SEED`` produces the same kills, drops, delays, and stalls, message
+for message, run after run.
+
+Spec (``CHAOS_SPEC``, JSON; every key optional)::
+
+    {"kill": {"actor-0": 30, "learner": 60},   # exit 137 at send/publish N
+     "drop_frac": 0.1,                          # fraction of chunks dropped
+     "delay_frac": 0.1, "delay_s": 0.05,        # fraction of chunks delayed
+     "stall_at": 20, "stall_s": 3.0}            # one publish stall window
+
+Determinism: one RNG draw per message, streamed from
+``seed ^ crc32(identity)``, so a message's fate depends only on (seed,
+identity, message index) — never on wall clock or interleaving.  Kills use
+``os._exit(137)``: no finally blocks, no atexit, no socket lingering —
+the closest a process gets to SIGKILLing itself.
+
+Respawn awareness: a supervisor-restarted process inherits the same env,
+and a deterministic kill-at-N would execute again every life — a kill
+loop, not a chaos test.  ``APEX_RESPAWN_COUNT`` (exported by
+``apex_tpu.fleet.supervise`` and by test harnesses doing their own
+restarts) therefore disarms the ``kill`` entries on every life after the
+first; drop/delay/stall schedules stay live.
+
+Activation is env-driven (``chaos_from_env``) so the localhost topology
+(``scripts/run_local.sh``), the deploy scripts, and pytest subprocesses
+all inject the same way: export and go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+import zlib
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ChaosPlan:
+    """The schedule resolved for ONE wire identity."""
+
+    seed: int
+    identity: str
+    kill_at: int | None = None      # message index to die at (armed lives)
+    drop_frac: float = 0.0
+    delay_frac: float = 0.0
+    delay_s: float = 0.05
+    stall_at: int | None = None     # publish index to stall at
+    stall_s: float = 0.0
+
+    def rng(self) -> random.Random:
+        return random.Random(self.seed ^ zlib.crc32(self.identity.encode()))
+
+
+class ChaosConfig:
+    """Parsed seed + spec; :meth:`plan_for` resolves one role's plan."""
+
+    def __init__(self, seed: int, spec: dict, respawn_count: int = 0):
+        self.seed = seed
+        self.spec = spec
+        self.respawn_count = respawn_count
+
+    def plan_for(self, identity: str) -> ChaosPlan:
+        kill = self.spec.get("kill", {}).get(identity)
+        if self.respawn_count > 0:
+            kill = None             # kills are first-life only (see above)
+        return ChaosPlan(
+            seed=self.seed, identity=identity,
+            kill_at=kill,
+            drop_frac=float(self.spec.get("drop_frac", 0.0)),
+            delay_frac=float(self.spec.get("delay_frac", 0.0)),
+            delay_s=float(self.spec.get("delay_s", 0.05)),
+            stall_at=self.spec.get("stall_at"),
+            stall_s=float(self.spec.get("stall_s", 0.0)))
+
+
+def chaos_from_env(environ=None) -> ChaosConfig | None:
+    """None unless ``CHAOS_SEED`` is set (empty string counts as unset, so
+    shell scripts can export it unconditionally)."""
+    e = os.environ if environ is None else environ
+    seed = e.get("CHAOS_SEED", "")
+    if not str(seed).strip():
+        return None
+    spec = json.loads(e.get("CHAOS_SPEC") or "{}")
+    return ChaosConfig(int(seed), spec,
+                       respawn_count=int(e.get("APEX_RESPAWN_COUNT", "0")
+                                         or 0))
+
+
+def _die(identity: str, index: int) -> None:
+    print(f"chaos: killing {identity} at message {index} (exit 137)",
+          flush=True)
+    os._exit(137)
+
+
+class ChaosChunkSender:
+    """Wraps :class:`apex_tpu.runtime.transport.ChunkSender`; one RNG draw
+    per chunk decides drop/delay, and ``kill_at`` fires on the send index.
+    A dropped chunk consumes no credit (the loss is actor-side, before the
+    socket) — the learner simply never sees it, exactly like a process
+    dying mid-buffer."""
+
+    def __init__(self, inner, plan: ChaosPlan, sleep=time.sleep):
+        self.inner = inner
+        self.plan = plan
+        self._sleep = sleep
+        self._rng = plan.rng()
+        self._n = 0
+        self.dropped = 0
+        self.delayed = 0
+
+    def send_chunk(self, msg, stop_event=None, max_wait_s=None) -> bool:
+        i = self._n
+        self._n += 1
+        if self.plan.kill_at is not None and i >= self.plan.kill_at:
+            _die(self.plan.identity, i)
+        r = self._rng.random()
+        if r < self.plan.drop_frac:
+            self.dropped += 1
+            return True
+        if r < self.plan.drop_frac + self.plan.delay_frac:
+            self.delayed += 1
+            self._sleep(self.plan.delay_s)
+        return self.inner.send_chunk(msg, stop_event, max_wait_s=max_wait_s)
+
+    # pass-throughs the adapters/emitters rely on
+    def send_stat(self, stat) -> None:
+        self.inner.send_stat(stat)
+
+    def reset_credits(self) -> None:
+        self.inner.reset_credits()
+
+    @property
+    def chunks_sent(self) -> int:
+        return self.inner.chunks_sent
+
+    @property
+    def acks_received(self) -> int:
+        return self.inner.acks_received
+
+    def close(self, *a, **kw) -> None:
+        self.inner.close(*a, **kw)
+
+
+class ChaosParamPublisher:
+    """Wraps :class:`apex_tpu.runtime.transport.ParamPublisher`; the
+    publish index drives the learner-side schedule (kill / stall)."""
+
+    def __init__(self, inner, plan: ChaosPlan, sleep=time.sleep):
+        self.inner = inner
+        self.plan = plan
+        self._sleep = sleep
+        self._n = 0
+        self.stalls = 0
+
+    def publish(self, version: int, params) -> None:
+        i = self._n
+        self._n += 1
+        if self.plan.kill_at is not None and i >= self.plan.kill_at:
+            _die(self.plan.identity, i)
+        if self.plan.stall_at is not None and i == self.plan.stall_at \
+                and self.plan.stall_s > 0:
+            self.stalls += 1
+            self._sleep(self.plan.stall_s)
+        self.inner.publish(version, params)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+def maybe_wrap_sender(sender, identity: str):
+    """Env-gated wrap for actor/evaluator chunk senders."""
+    chaos = chaos_from_env()
+    if chaos is None:
+        return sender
+    return ChaosChunkSender(sender, chaos.plan_for(identity))
+
+
+def maybe_wrap_publisher(publisher, identity: str = "learner"):
+    """Env-gated wrap for the learner's param publisher."""
+    chaos = chaos_from_env()
+    if chaos is None:
+        return publisher
+    return ChaosParamPublisher(publisher, chaos.plan_for(identity))

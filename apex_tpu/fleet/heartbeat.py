@@ -1,0 +1,94 @@
+"""Heartbeat message + emitter for the fleet control plane.
+
+Every role ships a periodic :class:`Heartbeat` on the stat channel it
+already has — workers via the pool stat queue, socket roles via
+``ChunkSender.send_stat`` (the adapters present both as one queue) — so
+membership costs zero new sockets.  The learner-side
+:class:`~apex_tpu.fleet.registry.FleetRegistry` turns the beat stream into
+the JOINING → ALIVE → SUSPECT → DEAD machine and the ``fleet_*`` scalars.
+
+Pure stdlib: the message crosses process boundaries (mp.Queue pickling and
+the restricted ZMQ wire — this class is on the
+:data:`apex_tpu.runtime.wire.ALLOWED_GLOBALS` allowlist), and worker
+children import it before JAX initializes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Heartbeat:
+    """One liveness report.  ``rejoins``/``parked`` are self-reported park
+    state (:mod:`apex_tpu.fleet.park`); counters are cumulative so the
+    registry can difference them across beats."""
+
+    identity: str                   # wire identity ("actor-3", "evaluator-…")
+    role: str = "actor"
+    pid: int = 0
+    host: str = ""
+    fps: float = 0.0                # env transitions/s over the beat window
+    param_version: int = 0
+    chunks_sent: int = 0
+    acks_received: int = 0
+    rejoins: int = 0                # park -> resume cycles this process
+    parked: bool = False
+    dropped_stats: int = 0          # same carry semantics as EpisodeStat
+
+
+class HeartbeatEmitter:
+    """Rate-limited beat factory for a worker/role loop.
+
+    The loop calls :meth:`tick` per transition batch and
+    :meth:`maybe_beat` once per iteration; a beat materializes at most
+    every ``interval_s``.  ``counters_fn``/``park_fn`` are optional hooks
+    into the transport layer (socket roles: the ChunkSender's wire
+    counters, the ParkController's state) — in-host pools run without
+    them and the emitter counts its own chunk puts.
+    """
+
+    def __init__(self, identity: str, role: str = "actor",
+                 interval_s: float = 2.0, counters_fn=None, park_fn=None,
+                 clock=time.monotonic):
+        self.identity = identity
+        self.role = role
+        self.interval_s = interval_s
+        self.counters_fn = counters_fn
+        self.park_fn = park_fn
+        self._clock = clock
+        self._pid = os.getpid()
+        self._host = socket.gethostname()
+        self._last = clock()
+        self._window_trans = 0
+        self.chunks_sent = 0        # local count when counters_fn is None
+
+    def tick(self, n: int = 1) -> None:
+        self._window_trans += n
+
+    def note_chunk(self) -> None:
+        self.chunks_sent += 1
+
+    def maybe_beat(self, param_version: int = 0) -> Heartbeat | None:
+        now = self._clock()
+        span = now - self._last
+        if span < self.interval_s:
+            return None
+        self._last = now
+        fps = self._window_trans / span if span > 0 else 0.0
+        self._window_trans = 0
+        counters = (self.counters_fn() if self.counters_fn is not None
+                    else {"chunks_sent": self.chunks_sent,
+                          "acks_received": 0})
+        parked, rejoins = (self.park_fn() if self.park_fn is not None
+                           else (False, 0))
+        return Heartbeat(
+            identity=self.identity, role=self.role, pid=self._pid,
+            host=self._host, fps=round(fps, 1),
+            param_version=int(param_version),
+            chunks_sent=int(counters.get("chunks_sent", 0)),
+            acks_received=int(counters.get("acks_received", 0)),
+            rejoins=int(rejoins), parked=bool(parked))

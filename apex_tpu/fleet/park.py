@@ -1,0 +1,151 @@
+"""Park-and-rejoin for actor/evaluator roles.
+
+A role whose param stream goes stale (no publish for
+``CommsConfig.park_after_s`` — a live learner republishes every couple of
+seconds, see ``training/apex.py``) must not spin, crash, or wedge: it
+PARKS.  Parked means the worker loop is blocked inside its queue adapter —
+env and :class:`~apex_tpu.replay.frame_chunks.FrameChunkBuilder` state
+stay exactly where they were, no acks are drained, no chunks ship — while
+this controller retries the learner with jittered exponential backoff.
+
+Each retry races the startup barrier against the param stream
+(:func:`apex_tpu.runtime.transport.barrier_wait` with ``rejoin_sub``): a
+learner respawned from its newest checkpoint re-releases the barrier
+before its first publish, so whichever signal lands first reattaches the
+fleet in seconds with no operator action.  On rejoin the sender's
+ack-credit window resets — the dead learner took the outstanding acks
+with it, and a stale window would wedge the first post-rejoin send
+forever.
+
+The spurious-park guard matters: a send wedged on credit exhaustion can
+mean EITHER a dead learner or a healthy-but-backpressuring one.  The
+controller therefore probes the CONFLATE subscriber first and only parks
+when the params themselves are stale; a probe that finds params stashes
+them (``take_pending``) so the worker's next poll still sees the newest
+weights.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import zlib
+
+from apex_tpu.config import CommsConfig
+
+
+class ParkController:
+    """One role's park/rejoin state.  Wired into the socket queue adapters
+    (:mod:`apex_tpu.runtime.roles`); never constructed for in-host pools
+    (the learner and its workers die together there)."""
+
+    def __init__(self, comms: CommsConfig, identity: str, stop_event,
+                 sub=None, sender=None, role: str = "actor",
+                 clock=time.monotonic, sleep=time.sleep):
+        self.comms = comms
+        self.identity = identity
+        self.role = role
+        self.stop_event = stop_event
+        self.sub = sub
+        self.sender = sender
+        self._clock = clock
+        self._sleep = sleep
+        self._last_params = clock()
+        self._pending = None
+        self.parked = False
+        self.parks = 0
+        self.rejoins = 0
+        # deterministic jitter per identity: a fleet parked by one learner
+        # death must not retry in lockstep (thundering-herd barrier hellos)
+        self._rng = random.Random(zlib.crc32(identity.encode()))
+
+    # -- freshness bookkeeping ---------------------------------------------
+
+    def note_params(self) -> None:
+        self._last_params = self._clock()
+
+    def stale(self) -> bool:
+        return (self._clock() - self._last_params
+                > self.comms.park_after_s)
+
+    def take_pending(self):
+        got, self._pending = self._pending, None
+        return got
+
+    def park_state(self) -> tuple[bool, int]:
+        """(parked, rejoins) — the HeartbeatEmitter's ``park_fn`` hook."""
+        return self.parked, self.rejoins
+
+    # -- the park loop ------------------------------------------------------
+
+    def _beat_parked(self) -> None:
+        """Best-effort parked heartbeat straight through the sender (the
+        worker loop is blocked in an adapter, so its own emitter is not
+        running) — visible when the learner is merely stalled, dropped on
+        the floor when it is gone."""
+        if self.sender is None:
+            return
+        from apex_tpu.fleet.heartbeat import Heartbeat
+        try:
+            self.sender.send_stat(Heartbeat(
+                identity=self.identity, role=self.role, parked=True,
+                rejoins=self.rejoins))
+        except Exception:
+            pass
+
+    def park_and_rejoin(self, sub=None):
+        """Block until the param stream is live again; returns the newest
+        ``(version, params)`` (also stashed for :meth:`take_pending`
+        callers) or None when not actually stale / stopped.
+
+        Called from two places: the param adapter's poll (found nothing,
+        staleness exceeded) and the chunk adapter's wedged send."""
+        from apex_tpu.runtime import transport
+
+        sub = sub if sub is not None else self.sub
+        got = sub.poll(0)
+        if got is not None:             # learner alive: never was a park
+            self.note_params()
+            self._pending = got
+            return got
+        if not self.stale() or self.stop_event.is_set():
+            return None
+
+        self.parked = True
+        self.parks += 1
+        backoff = self.comms.rejoin_backoff_s
+        try:
+            while not self.stop_event.is_set():
+                self._beat_parked()
+                if transport.barrier_wait(
+                        self.comms, self.identity,
+                        stop_event=self.stop_event,
+                        timeout_s=self.comms.rejoin_attempt_s,
+                        rejoin_sub=sub):
+                    got = self._await_params(sub)
+                    if got is not None:
+                        return got
+                    continue        # barrier said go but no publish: retry
+                self._sleep(min(backoff * (0.5 + self._rng.random()),
+                                self.comms.rejoin_backoff_max_s))
+                backoff = min(2 * backoff, self.comms.rejoin_backoff_max_s)
+        finally:
+            self.parked = False
+        return None
+
+    def _await_params(self, sub):
+        """Barrier released (or the stream twitched): wait out the
+        learner's first publish, then account the rejoin."""
+        deadline = self._clock() + 4 * self.comms.rejoin_attempt_s
+        while not self.stop_event.is_set() and self._clock() < deadline:
+            got = sub.poll(200)
+            if got is not None:
+                self.note_params()
+                self._pending = got
+                self.rejoins += 1
+                if self.sender is not None:
+                    # the dead learner never acked the in-flight window;
+                    # a stale window wedges the first post-rejoin send
+                    self.sender.reset_credits()
+                return got
+        return None

@@ -1,0 +1,280 @@
+"""Learner-side fleet membership: the heartbeat registry + status surface.
+
+The registry replaces the passive ``RemotePool.silent_peers(60.0)`` report
+with an explicit per-peer state machine driven by config thresholds
+(:class:`~apex_tpu.config.CommsConfig`):
+
+    JOINING --beat--> ALIVE --silence > suspect_after_s--> SUSPECT
+    SUSPECT --activity--> ALIVE     (recovery, not counted)
+    SUSPECT --silence > dead_after_s--> DEAD
+    DEAD    --activity--> ALIVE     (a REJOIN — counted)
+
+Two observation kinds feed it: :class:`~apex_tpu.fleet.heartbeat.Heartbeat`
+messages off the stat channel (rich: fps, counters, self-reported park
+state) and bare message-arrival times off the chunk socket
+(``observe_seen`` — keeps a backpressured-but-flowing actor ALIVE even
+when its stat puts drop).  ``fleet_rejoins`` sums registry-observed
+DEAD→ALIVE transitions with the fleet's self-reported park→resume cycles,
+so a learner restarted from checkpoint still credits the rejoins its
+predecessor's registry never saw.
+
+Thread contract: observations and ticks come from the trainer thread; the
+status server thread only calls :meth:`snapshot`, which takes the same
+lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from apex_tpu.config import CommsConfig
+from apex_tpu.fleet.heartbeat import Heartbeat
+
+JOINING, ALIVE, SUSPECT, DEAD = "JOINING", "ALIVE", "SUSPECT", "DEAD"
+
+
+@dataclass
+class PeerState:
+    identity: str
+    role: str = "?"
+    pid: int = 0
+    host: str = ""
+    state: str = JOINING
+    fps: float = 0.0
+    param_version: int = 0
+    chunks_sent: int = 0
+    acks_received: int = 0
+    rejoins_reported: int = 0
+    parked: bool = False
+    beats: int = 0
+    joined_at: float = 0.0
+    last_any: float = 0.0           # newest activity of either kind
+    last_beat: float | None = None  # newest heartbeat (gap statistics)
+    deaths: int = 0                 # ALIVE/SUSPECT -> DEAD transitions
+
+
+class FleetRegistry:
+    """Per-peer membership for one learner process."""
+
+    def __init__(self, comms: CommsConfig | None = None,
+                 clock=time.monotonic):
+        self.comms = comms or CommsConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.peers: dict[str, PeerState] = {}
+        self.dead_to_alive = 0          # registry-observed rejoins
+        self.transitions: list[tuple[str, str, str]] = []
+        self._gaps: deque[float] = deque(maxlen=512)   # beat-to-beat, s
+
+    # -- observations ------------------------------------------------------
+
+    def _peer(self, identity: str) -> PeerState:
+        p = self.peers.get(identity)
+        if p is None:
+            now = self._clock()
+            p = self.peers[identity] = PeerState(
+                identity=identity, joined_at=now, last_any=now)
+        return p
+
+    def _revive(self, p: PeerState) -> None:
+        """Activity from a non-ALIVE peer: recovery (SUSPECT) or rejoin
+        (DEAD, counted)."""
+        if p.state == DEAD:
+            self.dead_to_alive += 1
+            self.transitions.append((p.identity, DEAD, ALIVE))
+            p.state = ALIVE
+        elif p.state == SUSPECT:
+            self.transitions.append((p.identity, SUSPECT, ALIVE))
+            p.state = ALIVE
+
+    def observe(self, hb: Heartbeat) -> None:
+        """One heartbeat arrived (trainer thread, off the stat drain)."""
+        now = self._clock()
+        with self._lock:
+            p = self._peer(hb.identity)
+            if p.last_beat is not None:
+                self._gaps.append(now - p.last_beat)
+            if p.state == JOINING:
+                self.transitions.append((p.identity, JOINING, ALIVE))
+                p.state = ALIVE
+            else:
+                self._revive(p)
+            p.role, p.pid, p.host = hb.role, hb.pid, hb.host
+            p.fps, p.param_version = hb.fps, hb.param_version
+            p.chunks_sent, p.acks_received = hb.chunks_sent, hb.acks_received
+            p.rejoins_reported = max(p.rejoins_reported, hb.rejoins)
+            p.parked = hb.parked
+            p.beats += 1
+            p.last_beat = p.last_any = now
+
+    def observe_seen(self, seen: dict[str, float]) -> None:
+        """Message-arrival liveness from the chunk socket
+        (``RemotePool.peer_seen`` monotonic times): refreshes ``last_any``
+        without touching heartbeat gap statistics."""
+        with self._lock:
+            for identity, t in seen.items():
+                p = self._peer(identity)
+                if t > p.last_any:
+                    p.last_any = t
+                    self._revive(p)
+
+    # -- the clock-driven half of the machine ------------------------------
+
+    def tick(self) -> list[tuple[str, str, str]]:
+        """Apply the silence thresholds; returns the transitions taken
+        SINCE the last tick (observation-driven ones included)."""
+        now = self._clock()
+        c = self.comms
+        with self._lock:
+            for p in self.peers.values():
+                silent = now - p.last_any
+                if p.state in (ALIVE, JOINING) and silent > c.suspect_after_s:
+                    self.transitions.append((p.identity, p.state, SUSPECT))
+                    p.state = SUSPECT
+                if p.state == SUSPECT and silent > c.dead_after_s:
+                    self.transitions.append((p.identity, SUSPECT, DEAD))
+                    p.state = DEAD
+                    p.deaths += 1
+            out, self.transitions = self.transitions, []
+            return out
+
+    # -- read surface ------------------------------------------------------
+
+    def _counts(self) -> dict[str, int]:
+        out = {JOINING: 0, ALIVE: 0, SUSPECT: 0, DEAD: 0}
+        for p in self.peers.values():
+            out[p.state] += 1
+        return out
+
+    def rejoins(self) -> int:
+        with self._lock:
+            return self.dead_to_alive + sum(p.rejoins_reported
+                                            for p in self.peers.values())
+
+    def _gap_percentiles(self) -> tuple[float | None, float | None]:
+        if not self._gaps:
+            return None, None
+        s = sorted(self._gaps)
+
+        def pct(q: float) -> float:
+            return round(s[min(len(s) - 1, int(q * len(s)))], 3)
+
+        return pct(0.50), pct(0.99)
+
+    def metrics(self) -> dict:
+        """The ``fleet_*`` scalar set (MetricLogger + bench ``fleet``)."""
+        with self._lock:
+            counts = self._counts()
+            p50, p99 = self._gap_percentiles()
+            return {
+                "peers": len(self.peers),
+                "alive": counts[ALIVE],
+                "joining": counts[JOINING],
+                "suspect": counts[SUSPECT],
+                "dead": counts[DEAD],
+                "parked": sum(p.parked for p in self.peers.values()),
+                "rejoins": self.dead_to_alive
+                + sum(p.rejoins_reported for p in self.peers.values()),
+                "dead_to_alive": self.dead_to_alive,
+                "deaths": sum(p.deaths for p in self.peers.values()),
+                "hb_gap_p50_s": p50,
+                "hb_gap_p99_s": p99,
+            }
+
+    def snapshot(self) -> dict:
+        """Serializable fleet view (status server, fleet_summary.json):
+        plain builtins only, so the restricted wire carries it."""
+        now = self._clock()
+        with self._lock:
+            peers = [{
+                "identity": p.identity, "role": p.role, "state": p.state,
+                "pid": p.pid, "host": p.host, "fps": p.fps,
+                "param_version": p.param_version,
+                "chunks_sent": p.chunks_sent,
+                "acks_received": p.acks_received,
+                "rejoins": p.rejoins_reported, "parked": p.parked,
+                "beats": p.beats, "deaths": p.deaths,
+                "silent_s": round(now - p.last_any, 1),
+            } for _, p in sorted(self.peers.items())]
+        return {"peers": peers, "metrics": self.metrics()}
+
+
+def format_fleet_table(snapshot: dict) -> str:
+    """Human fleet table for ``--role status``."""
+    cols = ("identity", "role", "state", "pid", "host", "fps",
+            "param_version", "chunks_sent", "rejoins", "parked", "silent_s")
+    rows = [[str(p.get(c, "")) for c in cols] for p in snapshot["peers"]]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    m = snapshot.get("metrics", {})
+    lines.append("")
+    lines.append(
+        f"alive={m.get('alive')} suspect={m.get('suspect')} "
+        f"dead={m.get('dead')} parked={m.get('parked')} "
+        f"rejoins={m.get('rejoins')} "
+        f"hb_gap_p50={m.get('hb_gap_p50_s')}s "
+        f"p99={m.get('hb_gap_p99_s')}s")
+    return "\n".join(lines)
+
+
+class FleetStatusServer:
+    """REP socket serving registry snapshots on ``comms.status_port``.
+
+    Its own socket and its own thread — the ChunkReceiver's ROUTER stays
+    single-threaded, and a status query can never block the data plane.
+    zmq imports lazily so in-host trainers work without the comms extra.
+    """
+
+    def __init__(self, comms: CommsConfig, registry: FleetRegistry,
+                 bind_ip: str = "*"):
+        import zmq
+
+        self._zmq = zmq
+        self.registry = registry
+        self.sock = zmq.Context.instance().socket(zmq.REP)
+        self.sock.bind(f"tcp://{bind_ip}:{comms.status_port}")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        from apex_tpu.runtime import wire
+        while not self._stop.is_set():
+            if not self.sock.poll(200, self._zmq.POLLIN):
+                continue
+            self.sock.recv()            # any request frame means "status"
+            self.sock.send(wire.dumps(self.registry.snapshot()))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5)
+        self.sock.close(linger=0)
+
+
+def status_request(comms: CommsConfig, learner_ip: str | None = None,
+                   timeout_s: float = 5.0) -> dict | None:
+    """Client half of the status surface: one REQ round-trip to the
+    learner's :class:`FleetStatusServer`; None when nothing answers."""
+    import zmq
+
+    from apex_tpu.runtime import wire
+
+    sock = zmq.Context.instance().socket(zmq.REQ)
+    ip = learner_ip or comms.learner_ip
+    sock.connect(f"tcp://{ip}:{comms.status_port}")
+    try:
+        sock.send(b"status")
+        if sock.poll(int(timeout_s * 1000), zmq.POLLIN):
+            return wire.restricted_loads(sock.recv())
+        return None
+    finally:
+        sock.close(linger=0)

@@ -1,0 +1,120 @@
+"""Host supervisor: rate-limited, respawn-budgeted role relauncher.
+
+Replaces the bare ``while true; do python -m apex_tpu.runtime ...; sleep 5``
+loops the deploy bootstraps used to inline (``deploy/actor.sh``,
+``deploy/evaluator.sh``) with the SAME semantics the in-host pool applies
+to its workers (``apex_tpu.actors.pool.ActorPool``): respawns are a RATE,
+not a lifetime cap — ``--max-respawns`` per ``--window`` seconds anchored
+at the last respawn, so sporadic crashes over a long run never retire a
+healthy role, while a crash loop (child dying under ``--min-uptime``)
+backs off exponentially and eventually halts loudly.
+
+The child's rejoin path is the role's own (:mod:`apex_tpu.fleet.park` +
+the ``barrier_wait`` rejoin race), so a respawned process reattaches to a
+running learner in seconds.  ``APEX_RESPAWN_COUNT`` is exported to each
+life so the chaos harness (:mod:`apex_tpu.fleet.chaos`) can arm
+deterministic kills on the first life only.
+
+Pure stdlib — the supervisor must come up on a stock interpreter before
+the baked env, JAX, or zmq are importable.
+
+Usage::
+
+    python -m apex_tpu.fleet.supervise [--max-respawns N] [--window S]
+        [--min-uptime S] [--backoff S] [--backoff-max S] -- CMD [ARG...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import subprocess
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.fleet.supervise",
+        description="rate-limited role supervisor (ActorPool respawn "
+                    "semantics for whole processes)")
+    p.add_argument("--max-respawns", type=int, default=10,
+                   help="respawn budget per window (default 10)")
+    p.add_argument("--window", type=float, default=600.0,
+                   help="budget window seconds, anchored at the last "
+                        "respawn (default 600)")
+    p.add_argument("--min-uptime", type=float, default=60.0,
+                   help="a life shorter than this counts against the "
+                        "budget and doubles the backoff (default 60)")
+    p.add_argument("--backoff", type=float, default=5.0,
+                   help="initial respawn delay seconds (default 5)")
+    p.add_argument("--backoff-max", type=float, default=60.0,
+                   help="backoff ceiling seconds (default 60)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- then the role command to supervise")
+    return p
+
+
+def supervise(cmd: list[str], max_respawns: int = 10, window_s: float = 600.0,
+              min_uptime_s: float = 60.0, backoff_s: float = 5.0,
+              backoff_max_s: float = 60.0, sleep=time.sleep,
+              clock=time.monotonic, run=None) -> int:
+    """Run ``cmd`` until it exits 0 or the respawn budget is spent.
+    Returns the supervisor's exit code (0 = child finished cleanly,
+    1 = budget exhausted, last child rc otherwise on interrupt)."""
+    import os
+
+    run = run or (lambda c, env: subprocess.run(c, env=env).returncode)
+    rng = random.Random()
+    lives = 0
+    window_respawns = 0
+    last_respawn = 0.0
+    backoff = backoff_s
+    while True:
+        env = dict(os.environ, APEX_RESPAWN_COUNT=str(lives))
+        start = clock()
+        rc = run(cmd, env)
+        uptime = clock() - start
+        lives += 1
+        if rc == 0:
+            print(f"supervise: {cmd[0]} exited cleanly after "
+                  f"{uptime:.0f}s", flush=True)
+            return 0
+        # a full quiet window since the LAST respawn restores the budget
+        # (rate limit, not lifetime cap — ActorPool._refresh_budget)
+        if window_respawns and clock() - last_respawn > window_s:
+            window_respawns = 0
+        if uptime >= min_uptime_s:
+            backoff = backoff_s          # long life: crash was sporadic
+        else:
+            backoff = min(2 * backoff, backoff_max_s)
+        if window_respawns >= max_respawns:
+            print(f"supervise: {window_respawns} respawns inside "
+                  f"{window_s:.0f}s — crash loop, halting (rc={rc})",
+                  flush=True)
+            return 1
+        window_respawns += 1
+        last_respawn = clock()
+        delay = backoff * (0.5 + rng.random())   # jitter: no fleet lockstep
+        print(f"supervise: {cmd[0]} exited rc={rc} after {uptime:.0f}s; "
+              f"respawn {window_respawns}/{max_respawns} in {delay:.1f}s",
+              flush=True)
+        sleep(delay)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("supervise: no command given (… -- CMD ARG...)",
+              file=sys.stderr)
+        return 2
+    return supervise(cmd, max_respawns=args.max_respawns,
+                     window_s=args.window, min_uptime_s=args.min_uptime,
+                     backoff_s=args.backoff, backoff_max_s=args.backoff_max)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,7 @@ import queue as queue_lib
 import time
 
 from apex_tpu import native
+from apex_tpu.runtime.wire import restricted_loads
 
 
 class ShmRingError(RuntimeError):
@@ -194,7 +195,9 @@ class ShmChunkQueue:
         if got is not None:
             self._starved_since = None
             try:
-                return pickle.loads(got)
+                # restricted wire even in-host: one unpickler discipline
+                # for every process boundary (apexlint C005)
+                return restricted_loads(got)
             except Exception:
                 # a force-skipped producer's resurrected memcpy can corrupt
                 # one payload (shm_ring.cpp force-skip contract): count and

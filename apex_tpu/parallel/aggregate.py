@@ -69,7 +69,7 @@ class ChunkAggregator:
 
     def __getattr__(self, name):
         if name in ("dead_workers", "respawn_worker", "worker_deaths",
-                    "silent_peers"):
+                    "silent_peers", "peer_seen", "wire_rejected"):
             return getattr(self.pool, name)
         raise AttributeError(name)
 

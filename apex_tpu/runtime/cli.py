@@ -28,8 +28,9 @@ from __future__ import annotations
 import argparse
 import os
 
-from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, EnvConfig,
-                             LearnerConfig, ReplayConfig, RoleIdentity)
+from apex_tpu.config import (ActorConfig, ApexConfig, AQLConfig, CommsConfig,
+                             EnvConfig, LearnerConfig, ReplayConfig,
+                             RoleIdentity)
 
 
 def _env_bool(value: str) -> bool:
@@ -44,9 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="apex_tpu",
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
-                   choices=["learner", "actor", "evaluator", "dqn", "aql",
-                            "r2d2", "apex", "enjoy"],
+                   choices=["learner", "actor", "evaluator", "status",
+                            "dqn", "aql", "r2d2", "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator; "
+                        "status: print the live fleet table from the "
+                        "learner's registry; "
                         "single-host drivers: dqn/aql/r2d2/apex; "
                         "enjoy: eval a checkpoint")
     p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
@@ -71,6 +74,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-evaluators", type=int,
                    default=int(e.get("N_EVALUATORS", 1)))
     p.add_argument("--learner-ip", default=ident.learner_ip)
+    # comms ports (env twins let topology tests / multi-fleet hosts remap
+    # the whole plane without code changes)
+    c = CommsConfig()
+    p.add_argument("--batch-port", type=int,
+                   default=int(e.get("APEX_BATCH_PORT", c.batch_port)))
+    p.add_argument("--param-port", type=int,
+                   default=int(e.get("APEX_PARAM_PORT", c.param_port)))
+    p.add_argument("--barrier-port", type=int,
+                   default=int(e.get("APEX_BARRIER_PORT", c.barrier_port)))
+    p.add_argument("--status-port", type=int,
+                   default=int(e.get("APEX_STATUS_PORT", c.status_port)))
+    # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
+    # and the registry/park state-machine windows — env twins so a whole
+    # topology (tests, chaos drills) retunes them without flag plumbing
+    p.add_argument("--heartbeat-interval", type=float,
+                   default=float(e.get("APEX_HEARTBEAT_INTERVAL",
+                                       c.heartbeat_interval_s)))
+    p.add_argument("--suspect-after", type=float,
+                   default=float(e.get("APEX_SUSPECT_AFTER",
+                                       c.suspect_after_s)))
+    p.add_argument("--dead-after", type=float,
+                   default=float(e.get("APEX_DEAD_AFTER", c.dead_after_s)))
+    p.add_argument("--park-after", type=float,
+                   default=float(e.get("APEX_PARK_AFTER", c.park_after_s)))
     # learner
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--lr", type=float, default=6.25e-5)
@@ -159,6 +186,14 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
         actor=ActorConfig(n_actors=args.n_actors,
                           n_envs_per_actor=args.n_envs_per_actor),
         aql=AQLConfig(),
+        comms=CommsConfig(batch_port=args.batch_port,
+                          param_port=args.param_port,
+                          barrier_port=args.barrier_port,
+                          status_port=args.status_port,
+                          heartbeat_interval_s=args.heartbeat_interval,
+                          suspect_after_s=args.suspect_after,
+                          dead_after_s=args.dead_after,
+                          park_after_s=args.park_after),
     )
 
 
@@ -210,6 +245,18 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       episodes=args.episodes, logdir=args.logdir,
                       verbose=args.verbose,
                       barrier_timeout_s=args.barrier_timeout)
+    elif args.role == "status":
+        # operator surface: one REQ round-trip to the learner's fleet
+        # status server, rendered as the live membership table
+        from apex_tpu.fleet.registry import format_fleet_table, \
+            status_request
+        snap = status_request(cfg.comms, learner_ip=args.learner_ip)
+        if snap is None:
+            print(f"no fleet status from {args.learner_ip}:"
+                  f"{cfg.comms.status_port} (learner not running, or "
+                  f"an in-host trainer with no status server)")
+            return 1
+        print(format_fleet_table(snap))
     elif args.role in ("dqn", "aql", "r2d2", "apex"):
         # single-host drivers share one construct -> restore? -> train path
         if args.role == "dqn":

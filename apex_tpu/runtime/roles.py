@@ -40,36 +40,86 @@ from apex_tpu.runtime import transport
 class _ParamQueueAdapter:
     """ParamSubscriber presented as the worker body's param queue.  The
     CONFLATE socket holds at most one (newest) message, so the body's
-    drain-to-latest loop terminates after one hit."""
+    drain-to-latest loop terminates after one hit.
 
-    def __init__(self, sub: transport.ParamSubscriber):
+    With a :class:`~apex_tpu.fleet.park.ParkController` attached, a stale
+    param stream PARKS the worker right here — the loop is blocked inside
+    its routine poll, env and chunk-builder state intact — until the
+    rejoin race (barrier vs param stream) reattaches it."""
+
+    def __init__(self, sub: transport.ParamSubscriber, park=None):
         self.sub = sub
+        self.park = park
+
+    def _got(self, got):
+        if got is None:
+            if self.park is not None and self.park.stale():
+                got = self.park.park_and_rejoin(self.sub)
+                if got is not None:
+                    self.park.take_pending()    # consumed here, not twice
+            if got is None:
+                raise queue_lib.Empty
+        elif self.park is not None:
+            self.park.note_params()
+        return got
 
     def get(self, timeout: float = 0.5):
-        got = self.sub.poll(int(timeout * 1000))
-        if got is None:
-            raise queue_lib.Empty
-        return got
+        if self.park is not None:
+            pending = self.park.take_pending()
+            if pending is not None:
+                return pending
+        return self._got(self.sub.poll(int(timeout * 1000)))
 
     def get_nowait(self):
-        got = self.sub.poll(0)
-        if got is None:
-            raise queue_lib.Empty
-        return got
+        if self.park is not None:
+            pending = self.park.take_pending()
+            if pending is not None:
+                return pending
+        return self._got(self.sub.poll(0))
+
+    def park_state(self):
+        """HeartbeatEmitter ``park_fn`` hook: (parked, rejoins)."""
+        if self.park is None:
+            return (False, 0)
+        return self.park.park_state()
 
 
 class _ChunkQueueAdapter:
     """ChunkSender presented as the worker body's chunk queue; ``put``
     blocks on the ack-credit window like a bounded mp.Queue blocks on
-    depth."""
+    depth.
 
-    def __init__(self, sender: transport.ChunkSender, stop_event):
+    With a park controller attached, a WEDGED send (credit window
+    exhausted with nothing draining) checks the param stream: a healthy
+    backpressuring learner keeps publishing and the send just keeps
+    waiting; a dead one parks the worker here, and the rejoin resets the
+    credit window before this chunk re-sends."""
+
+    def __init__(self, sender: transport.ChunkSender, stop_event,
+                 park=None):
         self.sender = sender
         self.stop_event = stop_event
+        self.park = park
 
     def put(self, item) -> None:
         _kind, _actor_id, msg = item
-        self.sender.send_chunk(msg, self.stop_event)
+        if self.park is None:
+            self.sender.send_chunk(msg, self.stop_event)
+            return
+        while not self.stop_event.is_set():
+            if self.sender.send_chunk(msg, self.stop_event, max_wait_s=1.0):
+                return
+            # no credit for a full second: dead learner, or just slow?
+            # park_and_rejoin probes the param stream and only parks when
+            # it is stale too (the rejoin stashes fresh params for the
+            # param adapter's next poll and resets the credit window so
+            # this chunk can re-send)
+            self.park.park_and_rejoin()
+
+    def wire_counters(self) -> dict:
+        """HeartbeatEmitter ``counters_fn`` hook."""
+        return {"chunks_sent": self.sender.chunks_sent,
+                "acks_received": self.sender.acks_received}
 
 
 class _StatQueueAdapter:
@@ -157,6 +207,9 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     """
     from apex_tpu.actors.pool import _worker_main, actor_epsilons
 
+    from apex_tpu.fleet.chaos import maybe_wrap_sender
+    from apex_tpu.fleet.park import ParkController
+
     stop_event = stop_event or threading.Event()
     name = f"actor-{identity.actor_id}"
     comms = _with_ips(cfg.comms, identity)
@@ -164,7 +217,8 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     eps = actor_epsilons(identity.n_actors, cfg.actor.eps_base,
                          cfg.actor.eps_alpha)[identity.actor_id]
 
-    sender = transport.ChunkSender(comms, name)
+    sender = maybe_wrap_sender(transport.ChunkSender(comms, name), name)
+    park = ParkController(comms, name, stop_event, sub=sub, sender=sender)
     chunk_arg = cfg.actor.send_interval
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
@@ -208,8 +262,9 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
         raise ValueError(f"unknown family {family!r}")
     try:
         worker_fn(identity.actor_id, cfg, model_spec,
-                  _ChunkQueueAdapter(sender, stop_event),
-                  _ParamQueueAdapter(sub), _StatQueueAdapter(sender),
+                  _ChunkQueueAdapter(sender, stop_event, park=park),
+                  _ParamQueueAdapter(sub, park=park),
+                  _StatQueueAdapter(sender),
                   stop_event, float(eps), chunk_arg)
     finally:
         sender.close()
@@ -237,16 +292,21 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     # dedup at the barrier (deadlock) and misroute on the ROUTER.  The
     # random suffix makes N default-launched evaluators safe — unlike
     # actors, evaluator ids carry no semantics (no epsilon ladder slot)
+    from apex_tpu.fleet.chaos import maybe_wrap_sender
+    from apex_tpu.fleet.park import ParkController
+
     name = f"evaluator-{identity.actor_id}-{uuid.uuid4().hex[:6]}"
     comms = _with_ips(cfg.comms, identity)
     sub = _join_fleet(comms, name, stop_event, barrier_timeout_s)
 
-    sender = transport.ChunkSender(comms, name)
+    sender = maybe_wrap_sender(transport.ChunkSender(comms, name), name)
+    park = ParkController(comms, name, stop_event, sub=sub, sender=sender,
+                          role="evaluator")
     log = MetricLogger("evaluator", logdir, verbose=verbose)
     env = make_eval_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed + 7777)
     try:
         return _evaluator_body(cfg, identity, family, stop_event, episodes,
-                               max_steps, sub, sender, log, env)
+                               max_steps, sub, sender, log, env, park=park)
     finally:
         sender.close()
         sub.close()
@@ -254,11 +314,12 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
 
 
 def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
-                    sub, sender, log, env) -> list[float]:
+                    sub, sender, log, env, park=None) -> list[float]:
     import jax
     import jax.numpy as jnp
 
     from apex_tpu.actors.pool import EpisodeStat
+    from apex_tpu.fleet.heartbeat import HeartbeatEmitter
 
     reset_act = None            # recurrent families override per episode
     if family == "dqn":
@@ -305,6 +366,16 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
     if got is None:
         return []
     version, params = got
+    if park is not None:
+        park.note_params()
+    emitter = HeartbeatEmitter(
+        park.identity if park is not None
+        else f"evaluator-{identity.actor_id}",
+        role="evaluator", interval_s=cfg.comms.heartbeat_interval_s,
+        counters_fn=(lambda: {
+            "chunks_sent": getattr(sender, "chunks_sent", 0),
+            "acks_received": getattr(sender, "acks_received", 0)}),
+        park_fn=park.park_state if park is not None else None)
     key = jax.random.key(cfg.env.seed + 31337)
     scores: list[float] = []
     ep = 0
@@ -319,6 +390,10 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             total += float(r)
             done = term or trunc
             steps += 1
+            emitter.tick()
+            hb = emitter.maybe_beat(version)
+            if hb is not None:
+                sender.send_stat(hb)
         scores.append(total)
         log.scalars({"episode_reward": total, "episode_length": steps,
                      "param_version": version}, ep)
@@ -327,6 +402,15 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
         got = sub.poll(0)               # param refresh per episode
         if got is not None:
             version, params = got
+            if park is not None:
+                park.note_params()
+        elif park is not None and park.stale():
+            # the stream died mid-run: park between episodes, resume on
+            # the respawned learner's first publish
+            got = park.park_and_rejoin()
+            if got is not None:
+                park.take_pending()
+                version, params = got
         ep += 1
     return scores
 

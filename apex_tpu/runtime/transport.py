@@ -25,8 +25,11 @@ no shared-lock bottleneck (``origin_repo/README.md:11``).  What remains:
   learner publishes nothing until every expected peer has checked in.
 
 Wire format is pickle over zmq frames, like the reference's cPickle
-(``actor.py:1``, ``learner.py:6``); a trusted-cluster assumption both
-systems share.
+(``actor.py:1``, ``learner.py:6``) — but every RECEIVE routes through the
+allowlisted :mod:`apex_tpu.runtime.wire` unpickler, so the
+trusted-cluster assumption both systems share is now defense-in-depth
+instead of load-bearing: a payload referencing anything outside the
+message/stat/array allowlist is counted and dropped, never executed.
 """
 
 from __future__ import annotations
@@ -40,6 +43,7 @@ from dataclasses import dataclass
 import zmq
 
 from apex_tpu.config import CommsConfig
+from apex_tpu.runtime import wire
 
 
 def _ctx() -> zmq.Context:
@@ -75,11 +79,15 @@ class ParamSubscriber:
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
         ip = learner_ip or comms.learner_ip
         self.sock.connect(f"tcp://{ip}:{comms.param_port}")
+        self.rejected = 0           # payloads outside the wire allowlist
 
     def poll(self, timeout_ms: int = 0):
         """Newest ``(version, params)`` or None."""
         if self.sock.poll(timeout_ms, zmq.POLLIN):
-            return pickle.loads(self.sock.recv())
+            try:
+                return wire.restricted_loads(self.sock.recv())
+            except wire.WireRejected:
+                self.rejected += 1      # one bad publish costs one poll
         return None
 
     def wait_first(self, stop_event=None, timeout_ms: int = 500):
@@ -110,22 +118,43 @@ class ChunkSender:
         self.sock.connect(f"tcp://{ip}:{comms.batch_port}")
         self.max_outstanding = comms.max_outstanding_sends
         self._in_flight = 0
+        # fleet observability: cumulative wire counters (shipped in
+        # Heartbeats so the learner's registry can difference them)
+        self.chunks_sent = 0
+        self.acks_received = 0
 
     def _drain_acks(self, timeout_ms: int) -> None:
         while self.sock.poll(timeout_ms, zmq.POLLIN):
             self.sock.recv()
             self._in_flight = max(0, self._in_flight - 1)
+            self.acks_received += 1
             timeout_ms = 0
 
-    def send_chunk(self, msg: dict, stop_event=None) -> bool:
-        """Blocks while the credit window is exhausted; False if stopped."""
+    def reset_credits(self) -> None:
+        """Forget the outstanding window — the park/rejoin path calls this
+        after a learner death: the dead learner took the pending acks with
+        it, and a stale window would wedge the first post-rejoin send
+        forever.  Late acks from a fast restart land on an empty window
+        (the drain clamps at zero)."""
+        self._in_flight = 0
+
+    def send_chunk(self, msg: dict, stop_event=None,
+                   max_wait_s: float | None = None) -> bool:
+        """Blocks while the credit window is exhausted; False if stopped —
+        or, with ``max_wait_s``, if no credit arrived in time (the park
+        controller's wedge detection polls through this)."""
         self._drain_acks(0)
+        deadline = (None if max_wait_s is None
+                    else time.monotonic() + max_wait_s)
         while self._in_flight >= self.max_outstanding:
             if stop_event is not None and stop_event.is_set():
+                return False
+            if deadline is not None and time.monotonic() > deadline:
                 return False
             self._drain_acks(100)
         self.sock.send(pickle.dumps(("chunk", msg), protocol=5))
         self._in_flight += 1
+        self.chunks_sent += 1
         return True
 
     def send_stat(self, stat) -> None:
@@ -204,6 +233,7 @@ class ChunkReceiver:
         # instead of waiting out a full idle poll
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        self.rejected = 0          # payloads outside the wire allowlist
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._decoders = [
             threading.Thread(target=self._decode_loop, daemon=True)
@@ -252,7 +282,15 @@ class ChunkReceiver:
             except queue_lib.Empty:
                 continue
             try:
-                kind, body = pickle.loads(payload)
+                try:
+                    kind, body = wire.restricted_loads(payload)
+                except wire.WireRejected:
+                    # count + drop, and deliberately DON'T ack: garbage
+                    # must not earn its sender another credit (a hostile
+                    # or corrupt peer wedges its own window, nobody
+                    # else's)
+                    self.rejected += 1
+                    continue
                 if kind == "chunk":
                     with self._peers_lock:
                         self._chunk_senders.add(
@@ -388,7 +426,10 @@ class RemotePool:
 
     def start(self) -> None:
         self.receiver.start()
-        self.publisher = ParamPublisher(self.comms)
+        # chaos harness (env-gated, identity "learner"): deterministic
+        # publish stalls / kills inject here, on the real publisher
+        from apex_tpu.fleet.chaos import maybe_wrap_publisher
+        self.publisher = maybe_wrap_publisher(ParamPublisher(self.comms))
         released = barrier_release(self.comms, self.n_peers,
                                    timeout_s=self.barrier_timeout_s)
         if released < self.n_peers:
@@ -428,6 +469,18 @@ class RemotePool:
         except queue_lib.Empty:
             pass
         return out
+
+    def peer_seen(self) -> dict[str, float]:
+        """Locked snapshot of last message-arrival time per wire identity
+        (monotonic clock) — the FleetRegistry merges this so a
+        backpressured actor whose stat puts drop stays ALIVE as long as
+        its chunks keep landing."""
+        with self.receiver._peers_lock:
+            return dict(self.receiver.last_seen)
+
+    def wire_rejected(self) -> int:
+        """Payloads dropped by the restricted unpickler since start."""
+        return self.receiver.rejected
 
     def silent_peers(self, threshold_s: float = 60.0) -> list[str]:
         """CHUNK-sending peers (actors) that have sent nothing at all for

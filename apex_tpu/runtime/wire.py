@@ -1,0 +1,76 @@
+"""THE allowlisted unpickler for every socket/IPC boundary.
+
+The ZMQ plane's wire format is pickle (``runtime/transport.py``), which the
+reference justified with a trusted-cluster assumption — but a bare
+``pickle.loads`` turns any reachable port into remote code execution
+(``__reduce__`` payloads run arbitrary callables at load time).  This
+module closes that hole without changing the wire format:
+:class:`RestrictedUnpickler` resolves only the globals the fleet's real
+messages need — the stat/heartbeat dataclasses and the numpy/jax array
+reconstruction machinery — and anything else raises :class:`WireRejected`
+for the caller to count and drop.
+
+Every deserialization of cross-process bytes routes through
+:func:`restricted_loads`; apexlint rule C005 (``naked-pickle-loads``) flags
+``pickle.loads``/``pickle.Unpickler`` anywhere outside this module so the
+discipline cannot silently regress.
+
+Scope note: message CONTENT is structural (dicts/tuples/ndarrays pickle
+without find_class), so the allowlist stays tiny and adding a new message
+dataclass means adding exactly one ``(module, name)`` pair here.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+
+class WireRejected(pickle.UnpicklingError):
+    """A payload referenced a global outside the wire allowlist."""
+
+
+#: exact (module, name) pairs the fleet's wire messages resolve.  Stats:
+#: the worker stat dataclasses + fleet heartbeats.  Arrays: numpy's
+#: reconstruction helpers (both the numpy>=2 ``_core`` and the numpy<2
+#: ``core`` spellings, so mixed-version fleets interoperate) and jax's
+#: array rebuild hook (params are device_get before publish, but a jax
+#: array handed to a send path must not brick the receiver).
+ALLOWED_GLOBALS: frozenset[tuple[str, str]] = frozenset({
+    ("apex_tpu.actors.pool", "EpisodeStat"),
+    ("apex_tpu.actors.pool", "ActorTimingStat"),
+    ("apex_tpu.fleet.heartbeat", "Heartbeat"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("jax._src.array", "_reconstruct_array"),
+    ("flax.core.frozen_dict", "FrozenDict"),
+})
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    """Unpickler whose global resolution is exactly :data:`ALLOWED_GLOBALS`."""
+
+    def find_class(self, module: str, name: str):
+        if (module, name) in ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        raise WireRejected(
+            f"wire payload references {module}.{name}, which is outside "
+            f"the apex_tpu.runtime.wire allowlist — rejected")
+
+
+def restricted_loads(data: bytes):
+    """``pickle.loads`` with the wire allowlist; raises :class:`WireRejected`
+    on any global outside it (callers count and drop — a hostile or
+    corrupt payload must cost one message, never the process)."""
+    return RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def dumps(obj, protocol: int = 5) -> bytes:
+    """Serialization twin, so both wire directions import one module."""
+    return pickle.dumps(obj, protocol=protocol)

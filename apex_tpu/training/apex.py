@@ -33,6 +33,8 @@ import numpy as np
 
 from apex_tpu.actors.pool import ActorPool, ActorTimingStat
 from apex_tpu.config import ApexConfig
+from apex_tpu.fleet.heartbeat import Heartbeat
+from apex_tpu.fleet.registry import FleetRegistry
 from apex_tpu.parallel.aggregate import stack_chunk_messages
 from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
                                     unstacked_env_spec)
@@ -108,6 +110,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # cumulative count of stats workers dropped on a full stat queue
     actor_timing: dict | None = None
     stat_drops = 0
+    # fleet control plane (apex_tpu/fleet): the membership registry fed by
+    # Heartbeats off the stat drain (+ message-arrival liveness on socket
+    # pools), its REP status server (socket pools only), and where the
+    # periodic fleet_summary.json lands
+    fleet: FleetRegistry | None = None
+    _fleet_status = None
 
     # -- param plane -------------------------------------------------------
 
@@ -219,11 +227,24 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 key=self.key if sharded is not None else None)
             self._pipeline = pipeline
             self._pipeline_base = self.ingested
+        if self.fleet is None:
+            self.fleet = FleetRegistry(cfg.comms)
         try:
             pool.start()
         except BaseException:
             self._pipeline = None      # never started; don't route to it
             raise
+        if hasattr(pool, "peer_seen") and self._fleet_status is None:
+            # socket learner: serve live registry snapshots for
+            # `--role status` (own REP socket + thread; a bind failure —
+            # e.g. two learners on one host — degrades to no status
+            # surface, never to a dead learner)
+            try:
+                from apex_tpu.fleet.registry import FleetStatusServer
+                self._fleet_status = FleetStatusServer(cfg.comms, self.fleet)
+                self._fleet_status.start()
+            except Exception:
+                self._fleet_status = None
         if pipeline is not None:
             # staging starts only once the pool is live: its thread owns
             # every poll_chunks/publish_params call from here to stop()
@@ -340,21 +361,41 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
                 # Failure detection (beyond the reference, SURVEY.md §5.3:
                 # its fleets never notice actor death): crashed workers are
-                # logged and respawned on the same ladder slot.
+                # logged and respawned on the same ladder slot; remote
+                # peers run the fleet registry's JOINING/ALIVE/SUSPECT/DEAD
+                # machine (config thresholds in CommsConfig — this
+                # replaced the old hardcoded silent_peers(60.0) report).
                 if self.respawn_workers and now - last_health >= 5.0:
                     if hasattr(pool, "dead_workers"):      # local fleets
                         for dead in pool.dead_workers():
                             self.log.scalars({"worker_respawn": dead}, steps)
                             pool.respawn_worker(dead)
-                    if hasattr(pool, "silent_peers"):      # socket fleets
-                        silent = pool.silent_peers()
-                        if silent:
-                            self.log.scalars(
-                                {"silent_peers": len(silent)}, steps)
+                    if hasattr(pool, "peer_seen"):         # socket fleets:
+                        # chunk arrivals count as liveness even when a
+                        # backpressured actor's stat puts drop
+                        self.fleet.observe_seen(pool.peer_seen())
+                    for ident, old, new in self.fleet.tick():
+                        self.log.scalars(
+                            {f"fleet_{new.lower()}_transition": 1.0}, steps)
+                        if self.log.verbose or new in ("SUSPECT", "DEAD"):
+                            print(f"fleet: {ident} {old} -> {new}",
+                                  flush=True)
+                    fm = self.fleet.metrics()
+                    if fm["peers"]:
+                        self.log.scalars(
+                            {"fleet_alive": fm["alive"],
+                             "fleet_suspect": fm["suspect"],
+                             "fleet_dead": fm["dead"],
+                             "fleet_parked": fm["parked"],
+                             "fleet_rejoins": fm["rejoins"]}, steps)
+                    self._dump_fleet_summary()
                     last_health = now
 
                 for stat in pool.poll_stats():
                     self.stat_drops += getattr(stat, "dropped_stats", 0)
+                    if isinstance(stat, Heartbeat):
+                        self.fleet.observe(stat)
+                        continue
                     if isinstance(stat, ActorTimingStat):
                         self.actor_timing[stat.actor_id] = stat
                         self.log.scalars(
@@ -392,6 +433,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 self._pipeline_last_stats = dict(pipeline.stats)
                 pipeline.stop()
                 self._pipeline = None
+            if self._fleet_status is not None:
+                self._fleet_status.stop()
+                self._fleet_status = None
+            self._dump_fleet_summary()     # final registry state on disk
             pool.cleanup()
             stop = self._stop_requested
             if stop is not None:
@@ -425,6 +470,41 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 mean([t.dispatch_gap_ms_p50 for t in ts]),
             "stat_drops": self.stat_drops,
         }
+
+    def fleet_summary(self) -> dict | None:
+        """Registry snapshot + wire counters (the e2e bench ``fleet``
+        section, ``--role status``'s JSON sibling), or None before the
+        first train() call."""
+        if self.fleet is None:
+            return None
+        snap = self.fleet.snapshot()
+        rejected = getattr(self.pool, "wire_rejected", None)
+        snap["metrics"]["wire_rejected"] = (rejected()
+                                            if callable(rejected) else 0)
+        return snap
+
+    def _dump_fleet_summary(self) -> None:
+        """Persist the registry view next to the logs.  The on-disk copy
+        is the part of the control plane that SURVIVES the learner — the
+        chaos rejoin test reads a SIGKILLed learner's last periodic dump
+        to prove its registry saw the actor die and rejoin."""
+        logdir = getattr(self.log, "logdir", None)
+        if logdir is None or self.fleet is None:
+            return
+        import json
+        import os
+        summary = self.fleet_summary()
+        summary["steps"] = self.steps_rate.total
+        summary["ingested"] = self.ingested
+        path = os.path.join(logdir, "fleet_summary.json")
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(summary, fh, indent=2)
+            os.replace(tmp, path)      # readers never see a torn write
+        except OSError:
+            pass                       # observability must not kill a run
 
     def _beta(self, ingested: int | None = None) -> float:
         n = self.ingested if ingested is None else ingested

@@ -38,6 +38,7 @@ class MetricLogger:
 
     def __init__(self, role: str, logdir: str | None = None, verbose: bool = False):
         self.role = role
+        self.logdir = logdir        # sidecar artifacts (fleet_summary.json)
         self.verbose = verbose
         self._writer = None
         if logdir is not None:

@@ -542,6 +542,9 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
         def poll_chunks(self, max_chunks, timeout=0.0):
             out = []
             while self._stream and len(out) < max_chunks:
+                # in-process replay of a stream this bench pickled
+                # itself; no trust boundary
+                # apexlint: disable=C005 -- same-process bench stream
                 out.append(pickle.loads(self._stream.pop(0)))
             return out
 
@@ -562,6 +565,8 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
         key_f, key_t = jax.random.split(jax.random.key(999))
         beta = jnp.float32(0.4)
         merge_max = trainer.cfg.learner.pipeline_merge
+        # same-process roundtrip of blobs this function pickled above
+        # apexlint: disable=C005 -- same-process bench stream
         msgs = [pickle.loads(b) for b in blobs[:merge_max * max(1, n_dp)]]
         if n_dp > 1:
             # the dp lanes dispatch GROUP-granular payloads (aggregator
@@ -885,6 +890,24 @@ def bench_actor_plane() -> dict:
 
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
+def _fleet_section(trainer) -> dict | None:
+    """Fleet control-plane view of the e2e run (apex_tpu/fleet): state
+    counts, heartbeat gap percentiles, and rejoin count from the same
+    registry the socket learner serves on ``--role status`` — the in-host
+    worker fleet beats over the stat queue, so the section is live even
+    without sockets."""
+    summary = trainer.fleet_summary()
+    if summary is None:
+        return None
+    m = summary["metrics"]
+    return {"peers": m["peers"], "alive": m["alive"],
+            "suspect": m["suspect"], "dead": m["dead"],
+            "parked": m["parked"], "rejoins": m["rejoins"],
+            "hb_gap_p50_s": m["hb_gap_p50_s"],
+            "hb_gap_p99_s": m["hb_gap_p99_s"],
+            "wire_rejected": m.get("wire_rejected", 0)}
+
+
 def bench_end_to_end(e2e_seconds: float) -> dict:
     """The real ApexTrainer pipeline — vectorized actor processes feeding
     the fused learner through the shm chunk plane — on the PIXEL env
@@ -986,6 +1009,7 @@ def bench_end_to_end(e2e_seconds: float) -> dict:
             "scan_steps": scan_steps,
             "scan_dispatches": trainer.scan_dispatches,
             "actor_plane": trainer.actor_plane(),
+            "fleet": _fleet_section(trainer),
             "ingest_pipeline": trainer._pipeline_last_stats,
             "dispatch_gap": (trainer._dispatch_gap.snapshot()
                              if trainer._dispatch_gap is not None else None),

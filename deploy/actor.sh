@@ -13,28 +13,23 @@ cd apex-tpu
 [ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
 /opt/apex-env/bin/pip install -e . --no-deps
 
-# Supervisor loop: a crashed actor is relaunched after a short backoff —
-# the role's join path (runtime/roles.py:_join_fleet, transport.barrier_wait
-# rejoin contract) lets the respawn pass the long-gone startup barrier by
-# observing the param stream, and the learner's silent_peers report clears
-# on its first chunk.  A child that keeps dying young (<60s uptime) stops
-# being respawned after 10 consecutive short-lived runs.
+# Host supervisor (apex_tpu.fleet.supervise): rate-limited, respawn-
+# budgeted relaunch with jittered exponential backoff — the ActorPool
+# respawn semantics applied to whole processes.  A crashed actor's
+# respawn rejoins the running fleet through the role's own park path
+# (runtime/roles.py adapters + fleet/park.py: the barrier-vs-param-stream
+# race), and the learner's FleetRegistry reports the DEAD -> ALIVE
+# transition; a child that keeps dying young exhausts the budget and the
+# supervisor halts loudly instead of crash-looping.
 idx=0
 while [ $idx -lt ${actors_per_node} ]; do
   ACTOR_ID=$(( ${node_id} * ${actors_per_node} + idx ))
   tmux new -s "actor-$ACTOR_ID" -d \
-    "fails=0; \
-     while true; do \
-       start=\$(date +%s); \
-       JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
-       N_ENVS_PER_ACTOR=${envs_per_actor} \
-       LEARNER_IP=${learner_ip} /opt/apex-env/bin/python -m apex_tpu.runtime \
-       --env-id ${env_id} --barrier-timeout 1800; \
-       rc=\$?; \
-       if [ \$(( \$(date +%s) - start )) -gt 60 ]; then fails=0; fi; \
-       fails=\$(( fails + 1 )); \
-       if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
-       echo \"actor-$ACTOR_ID exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \
-     done; read"
+    "JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
+     N_ENVS_PER_ACTOR=${envs_per_actor} LEARNER_IP=${learner_ip} \
+     /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+       --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+       /opt/apex-env/bin/python -m apex_tpu.runtime \
+       --env-id ${env_id} --barrier-timeout 1800; read"
   idx=$(( idx + 1 ))
 done

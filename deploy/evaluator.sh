@@ -10,20 +10,15 @@ cd apex-tpu
 [ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
 /opt/apex-env/bin/pip install -e . --no-deps
 
-# Supervisor loop mirrors deploy/actor.sh: crashed evaluators respawn
-# (rejoining via the param stream once the startup barrier is gone);
-# 10 consecutive short-lived (<60s) runs halt the respawns.
+# Host supervisor mirrors deploy/actor.sh (apex_tpu.fleet.supervise):
+# rate-limited respawns with jittered backoff; the respawned evaluator
+# rejoins via the park path's barrier-vs-param-stream race once the
+# startup barrier is gone.
 tmux new -s evaluator -d \
-  "fails=0; \
-   while true; do \
-     start=\$(date +%s); \
-     JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs /opt/apex-env/bin/python -m apex_tpu.runtime \
+  "JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs \
+   /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+     --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+     /opt/apex-env/bin/python -m apex_tpu.runtime \
      --role evaluator --env-id ${env_id} --learner-ip ${learner_ip} \
-     --barrier-timeout 1800 --verbose; \
-     rc=\$?; \
-     if [ \$(( \$(date +%s) - start )) -gt 60 ]; then fails=0; fi; \
-     fails=\$(( fails + 1 )); \
-     if [ \$fails -gt 10 ]; then echo 'crash loop; halting respawns'; break; fi; \
-     echo \"evaluator exited rc=\$rc; respawn \$fails in 5s\"; sleep 5; \
-   done; read"
+     --barrier-timeout 1800 --verbose; read"
 tmux new -s tensorboard -d "/opt/apex-env/bin/tensorboard --logdir /opt/apex-tpu/runs --host 0.0.0.0; read"

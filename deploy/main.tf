@@ -22,7 +22,8 @@ output "learner_ip" {
 # -- network ---------------------------------------------------------------
 # The reference opens 51001-51003 (replay) and 52001-52002 (learner)
 # (deploy.tf:64-126); without the replay server only the learner ports
-# remain: 51001 chunk ingest, 52001 param PUB, 52002 barrier.
+# remain: 51001 chunk ingest, 52001 param PUB, 52002 barrier, 52003
+# fleet status (`--role status` queries from any fleet node).
 
 resource "google_compute_firewall" "apex_ports" {
   name    = "apex-tpu-ports"
@@ -30,7 +31,7 @@ resource "google_compute_firewall" "apex_ports" {
 
   allow {
     protocol = "tcp"
-    ports    = ["51001", "52001", "52002", "6006"] # 6006: tensorboard
+    ports    = ["51001", "52001", "52002", "52003", "6006"] # 6006: tensorboard
   }
 
   source_tags = ["apex-actor", "apex-evaluator"]

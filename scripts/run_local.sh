@@ -16,6 +16,14 @@ ENVS_PER_ACTOR="${4:-1}"
 # single-client TPU tunnel; drop the env vars on the learner line to put its
 # fused step on the chip.
 export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+# Deterministic fault injection (apex_tpu/fleet/chaos.py): export
+# CHAOS_SEED (+ optional CHAOS_SPEC JSON) before launching and every role
+# inherits the same seeded fault schedule — kills at message N, chunk
+# drops/delays, publish stalls — replayable run after run.  Example:
+#   CHAOS_SEED=7 CHAOS_SPEC='{"kill":{"actor-0":200},"drop_frac":0.05}' \
+#     scripts/run_local.sh
+export CHAOS_SEED="${CHAOS_SEED:-}" CHAOS_SPEC="${CHAOS_SPEC:-}"
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
         --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500

@@ -759,6 +759,120 @@ def test_c004_fires_on_unguarded_class_unlink():
         """, "C004")
 
 
+# -- C005: naked pickle loads ----------------------------------------------
+
+def test_c005_fires_on_naked_pickle_loads():
+    assert fires("""
+        import pickle
+        def recv(sock):
+            return pickle.loads(sock.recv())
+        """, "C005")
+
+
+def test_c005_fires_on_unpickler_construction():
+    assert fires("""
+        import io, pickle
+        def recv(data):
+            return pickle.Unpickler(io.BytesIO(data)).load()
+        """, "C005")
+    assert fires("""
+        import io
+        from pickle import Unpickler
+        def recv(data):
+            return Unpickler(io.BytesIO(data)).load()
+        """, "C005")
+
+
+def test_c005_silent_through_restricted_wire():
+    # the sanctioned path: route receives through the allowlisted module
+    assert not fires("""
+        from apex_tpu.runtime import wire
+        def recv(sock):
+            return wire.restricted_loads(sock.recv())
+        """, "C005")
+    # dumps (send side) and json.loads are not unpickles
+    assert not fires("""
+        import json, pickle
+        def send(sock, msg):
+            sock.send(pickle.dumps(msg))
+            return json.loads(sock.recv())
+        """, "C005")
+
+
+def test_c005_allowlisted_module_is_exempt():
+    # wire.py IS the restricted unpickler — the one place a raw
+    # Unpickler may exist
+    src = textwrap.dedent("""
+        import pickle
+        class RestrictedUnpickler(pickle.Unpickler):
+            pass
+        def restricted_loads(data):
+            import io
+            return RestrictedUnpickler(io.BytesIO(data)).load()
+        """)
+    rules = {"C005": all_rules()["C005"]}
+    findings, _ = analyze_source(src, path="apex_tpu/runtime/wire.py",
+                                 rules=rules)
+    assert not findings
+
+
+# -- J009: device arrays on mp queues ---------------------------------------
+
+def test_j009_fires_on_device_result_put():
+    assert fires("""
+        import jax
+        policy = jax.jit(policy_fn)
+        def worker(params, x, chunk_queue):
+            while True:
+                actions, q_values = policy(params, x)
+                chunk_queue.put((actions, q_values))
+        """, "J009")
+
+
+def test_j009_silent_with_host_materialize():
+    # materialized inline at the put site
+    assert not fires("""
+        import jax
+        import numpy as np
+        policy = jax.jit(policy_fn)
+        def worker(params, x, chunk_queue):
+            while True:
+                actions, q_values = policy(params, x)
+                chunk_queue.put((int(actions[0]), np.asarray(q_values)))
+        """, "J009")
+    # or rebound to a host var first
+    assert not fires("""
+        import jax
+        import numpy as np
+        policy = jax.jit(policy_fn)
+        def worker(params, x, stat_q):
+            while True:
+                q_values = policy(params, x)
+                host_q = np.asarray(q_values)
+                stat_q.put_nowait(host_q)
+        """, "J009")
+
+
+def test_j009_silent_on_host_data_and_non_queues():
+    # plain host messages on queues are the normal case
+    assert not fires("""
+        import jax
+        policy = jax.jit(policy_fn)
+        def worker(chunk_queue, builder, params, x):
+            a = policy(params, x)
+            for msg in builder.poll():
+                chunk_queue.put(("chunk", 0, msg))
+        """, "J009")
+    # a non-queue receiver named `sink` is out of scope
+    assert not fires("""
+        import jax
+        policy = jax.jit(policy_fn)
+        def worker(sink, params, x):
+            a = policy(params, x)
+            sink.put(a)
+        """, "J009")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

@@ -87,7 +87,8 @@ def test_firewall_ports_match_comms_config():
     assert m, "no firewall ports list"
     ports = {int(p) for p in re.findall(r'"(\d+)"', m.group(1))}
     c = CommsConfig()
-    assert {c.batch_port, c.param_port, c.barrier_port} <= ports
+    assert {c.batch_port, c.param_port, c.barrier_port,
+            c.status_port} <= ports
     assert 6006 in ports                     # tensorboard
     assert c.prios_port not in ports and c.sample_port not in ports, \
         "replay-server ports resurrected — that server is dissolved"
@@ -206,12 +207,21 @@ def test_validate_binaries_if_available():
         assert p.returncode == 0, p.stderr
 
 
-def test_bootstrap_scripts_have_supervisor_loops():
+def test_bootstrap_scripts_use_host_supervisor():
     """Crashed remote roles must respawn (VERDICT r3 weak #6): the actor
-    and evaluator bootstraps carry the rate-limited supervisor loop that
-    pairs with roles.py's param-stream rejoin path."""
+    and evaluator bootstraps launch through the rate-limited,
+    respawn-budgeted host supervisor (apex_tpu.fleet.supervise — the
+    ActorPool respawn semantics for whole processes), which pairs with
+    the roles' park/rejoin path.  The old inline ``while true`` loops
+    must stay gone: they had no budget window and no jitter."""
     for name in ("actor.sh", "evaluator.sh"):
         text = (DEPLOY / name).read_text()
-        assert "while true" in text, f"{name}: no respawn loop"
-        assert "sleep 5" in text, f"{name}: no respawn backoff"
-        assert "fails" in text, f"{name}: no crash-loop rate limit"
+        assert "apex_tpu.fleet.supervise" in text, \
+            f"{name}: role not launched under the host supervisor"
+        assert "--max-respawns" in text and "--window" in text, \
+            f"{name}: supervisor launched without a respawn budget"
+        assert "/opt/apex-env/bin/python -m apex_tpu.fleet.supervise" \
+            in text, f"{name}: supervisor not run from the baked env"
+        assert "while true" not in text, \
+            f"{name}: bare respawn loop resurrected alongside the " \
+            f"supervisor"

@@ -1,0 +1,568 @@
+"""Fleet control plane (apex_tpu/fleet): registry machine, heartbeats,
+park-and-rejoin, the chaos harness, the restricted wire, and the host
+supervisor.
+
+Everything here is tier-1: deterministic (fake clocks / seeded schedules)
+and fast (the socket tests run whole learner-death dramas in-process on
+localhost with sub-second thresholds).  The multi-process SIGKILL soak
+lives in ``tests/test_fleet_rejoin.py`` behind ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+
+import pytest
+
+from apex_tpu.config import CommsConfig
+from apex_tpu.fleet.chaos import (ChaosChunkSender, ChaosConfig,
+                                  ChaosParamPublisher, chaos_from_env)
+from apex_tpu.fleet.heartbeat import Heartbeat, HeartbeatEmitter
+from apex_tpu.fleet.park import ParkController
+from apex_tpu.fleet.registry import (ALIVE, DEAD, JOINING, SUSPECT,
+                                     FleetRegistry, FleetStatusServer,
+                                     format_fleet_table, status_request)
+from apex_tpu.fleet.supervise import supervise
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _comms(**overrides) -> CommsConfig:
+    batch, param, barrier, status = _free_ports(4)
+    return CommsConfig(batch_port=batch, param_port=param,
+                       barrier_port=barrier, status_port=status,
+                       **overrides)
+
+
+# -- registry state machine -------------------------------------------------
+
+def test_registry_state_machine_and_rejoin_accounting():
+    """JOINING -> ALIVE -> SUSPECT -> DEAD -> ALIVE under a fake clock;
+    DEAD->ALIVE counts as a rejoin, SUSPECT->ALIVE recovery does not."""
+    t = [0.0]
+    comms = CommsConfig(suspect_after_s=2.0, dead_after_s=5.0)
+    reg = FleetRegistry(comms, clock=lambda: t[0])
+
+    reg.observe(Heartbeat("actor-0", fps=100.0, param_version=3))
+    assert reg.peers["actor-0"].state == ALIVE
+    assert ("actor-0", JOINING, ALIVE) in reg.tick()
+
+    t[0] = 3.0                              # silent past suspect_after_s
+    assert ("actor-0", ALIVE, SUSPECT) in reg.tick()
+    reg.observe(Heartbeat("actor-0"))       # recovery: NOT a rejoin
+    assert reg.peers["actor-0"].state == ALIVE
+    assert reg.metrics()["rejoins"] == 0
+
+    t[0] = 10.0                             # silent past dead_after_s
+    trans = reg.tick()
+    assert ("actor-0", ALIVE, SUSPECT) in trans
+    assert ("actor-0", SUSPECT, DEAD) in trans
+    assert reg.metrics()["dead"] == 1 and reg.metrics()["deaths"] == 1
+
+    reg.observe(Heartbeat("actor-0"))       # back from the dead: a rejoin
+    m = reg.metrics()
+    assert m["alive"] == 1 and m["dead_to_alive"] == 1 and m["rejoins"] == 1
+
+
+def test_registry_merges_self_reported_rejoins_and_seen_liveness():
+    """fleet_rejoins survives a learner restart: a FRESH registry credits
+    the fleet's self-reported park->resume cycles; chunk-arrival times
+    (observe_seen) keep a stat-dropping peer alive."""
+    t = [0.0]
+    comms = CommsConfig(suspect_after_s=2.0, dead_after_s=5.0)
+    reg = FleetRegistry(comms, clock=lambda: t[0])
+    reg.observe(Heartbeat("actor-0", rejoins=1))
+    reg.observe(Heartbeat("actor-1", rejoins=1))
+    assert reg.rejoins() == 2               # no DEAD->ALIVE seen here
+
+    # chunks keep flowing while heartbeats drop: stays ALIVE
+    t[0] = 4.0
+    reg.observe_seen({"actor-0": 3.9})
+    trans = reg.tick()
+    assert ("actor-1", ALIVE, SUSPECT) in trans
+    assert reg.peers["actor-0"].state == ALIVE
+
+    # a DEAD peer revived by message arrival also counts as a rejoin
+    t[0] = 20.0
+    reg.tick()
+    assert reg.peers["actor-0"].state == DEAD
+    reg.observe_seen({"actor-0": 20.0})
+    assert reg.peers["actor-0"].state == ALIVE
+    assert reg.rejoins() == 3
+
+
+def test_registry_gap_percentiles_and_table():
+    t = [0.0]
+    reg = FleetRegistry(CommsConfig(), clock=lambda: t[0])
+    for i in range(1, 11):
+        t[0] = float(i)
+        reg.observe(Heartbeat("actor-0", fps=50.0))
+    m = reg.metrics()
+    assert m["hb_gap_p50_s"] == pytest.approx(1.0)
+    assert m["hb_gap_p99_s"] == pytest.approx(1.0)
+    table = format_fleet_table(reg.snapshot())
+    assert "actor-0" in table and "ALIVE" in table and "rejoins" in table
+
+
+def test_heartbeat_emitter_cadence_and_hooks():
+    t = [0.0]
+    beats = []
+    em = HeartbeatEmitter(
+        "actor-7", role="actor", interval_s=2.0,
+        counters_fn=lambda: {"chunks_sent": 42, "acks_received": 40},
+        park_fn=lambda: (True, 3), clock=lambda: t[0])
+    assert em.maybe_beat(1) is None         # not due yet
+    t[0] = 2.5
+    em.tick(50)
+    hb = em.maybe_beat(9)
+    assert hb is not None and hb.identity == "actor-7"
+    assert hb.param_version == 9 and hb.chunks_sent == 42
+    assert hb.parked and hb.rejoins == 3
+    assert hb.fps == pytest.approx(50 / 2.5, rel=0.01)
+    assert em.maybe_beat(9) is None         # window reset
+    beats.append(hb)
+
+
+# -- restricted wire --------------------------------------------------------
+
+def test_wire_roundtrips_every_message_type():
+    import numpy as np
+
+    from apex_tpu.actors.pool import ActorTimingStat, EpisodeStat
+    from apex_tpu.runtime import wire
+
+    msgs = [
+        ("chunk", {"payload": {"frames": np.zeros((4, 3), np.uint8)},
+                   "priorities": np.ones(4, np.float32), "n_trans": 4}),
+        ("stat", EpisodeStat(1, 2.5, 30, 7)),
+        ("stat", ActorTimingStat(0, 100.0, .1, .2, .3, .4, 256, True)),
+        ("stat", Heartbeat("actor-0", fps=12.5, chunks_sent=3)),
+        (5, {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}),
+        np.float32(1.5),
+    ]
+    for msg in msgs:
+        got = wire.restricted_loads(wire.dumps(msg))
+        assert type(got) is type(msg)
+
+
+def test_wire_rejects_non_allowlisted_globals():
+    import os
+    import pickle
+
+    from apex_tpu.runtime import wire
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    with pytest.raises(wire.WireRejected):
+        wire.restricted_loads(pickle.dumps(Evil()))
+    # even benign-but-unlisted classes are rejected: allowlist, not
+    # blocklist
+    with pytest.raises(wire.WireRejected):
+        wire.restricted_loads(pickle.dumps(CommsConfig()))
+
+
+def test_receiver_counts_and_drops_rejected_payloads():
+    """A hostile payload on the chunk socket costs one message (counted),
+    earns no ack, and the pipe keeps working for honest peers."""
+    import pickle
+
+    import zmq
+
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    comms = _comms()
+    recv = ChunkReceiver(comms, queue_depth=8)
+    recv.start()
+    try:
+        evil = zmq.Context.instance().socket(zmq.DEALER)
+        evil.setsockopt(zmq.IDENTITY, b"mallory")
+        evil.connect(f"tcp://127.0.0.1:{comms.batch_port}")
+
+        class Evil:
+            def __reduce__(self):
+                import os
+                return (os.system, ("true",))
+
+        evil.send(pickle.dumps(("chunk", Evil())))
+        deadline = time.monotonic() + 10
+        while recv.rejected == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert recv.rejected == 1
+        evil.close(linger=0)
+
+        s = ChunkSender(comms, "actor-0")
+        assert s.send_chunk({"n": 1})
+        assert recv.chunks.get(timeout=5.0) == {"n": 1}
+        s.close()
+    finally:
+        recv.stop()
+
+
+# -- chaos harness ----------------------------------------------------------
+
+class _StubSender:
+    def __init__(self):
+        self.sent = []
+        self.chunks_sent = 0
+        self.acks_received = 0
+
+    def send_chunk(self, msg, stop_event=None, max_wait_s=None):
+        self.sent.append(msg)
+        self.chunks_sent += 1
+        return True
+
+    def send_stat(self, stat):
+        pass
+
+    def reset_credits(self):
+        pass
+
+    def close(self, *a, **kw):
+        pass
+
+
+def test_chaos_schedule_is_deterministic_per_identity():
+    """Same seed + identity -> the same per-message drop/delay decisions,
+    run after run; a different identity draws a different stream."""
+    spec = {"drop_frac": 0.3, "delay_frac": 0.2, "delay_s": 0.0}
+
+    def fates(identity, seed=7):
+        plan = ChaosConfig(seed, spec).plan_for(identity)
+        inner = _StubSender()
+        cs = ChaosChunkSender(inner, plan, sleep=lambda s: None)
+        fate = []
+        for i in range(200):
+            before = len(inner.sent)
+            delayed_before = cs.delayed
+            cs.send_chunk({"i": i})
+            fate.append(("drop" if len(inner.sent) == before else
+                         "delay" if cs.delayed > delayed_before else "send"))
+        return fate
+
+    a1, a2 = fates("actor-0"), fates("actor-0")
+    assert a1 == a2
+    assert fates("actor-1") != a1
+    assert 30 < a1.count("drop") < 90          # ~0.3 of 200
+
+
+def test_chaos_kill_disarms_on_respawned_lives(monkeypatch):
+    """APEX_RESPAWN_COUNT>0 (exported by the supervisor) disarms kill
+    entries so a deterministic kill-at-N cannot become a kill loop;
+    drop/delay schedules stay live."""
+    monkeypatch.setenv("CHAOS_SEED", "3")
+    monkeypatch.setenv("CHAOS_SPEC",
+                       '{"kill": {"actor-0": 5}, "drop_frac": 0.5}')
+    cfg = chaos_from_env()
+    assert cfg.plan_for("actor-0").kill_at == 5
+    assert cfg.plan_for("actor-1").kill_at is None
+
+    monkeypatch.setenv("APEX_RESPAWN_COUNT", "1")
+    cfg = chaos_from_env()
+    assert cfg.plan_for("actor-0").kill_at is None
+    assert cfg.plan_for("actor-0").drop_frac == 0.5
+
+    monkeypatch.setenv("CHAOS_SEED", "")      # empty string = chaos off
+    assert chaos_from_env() is None
+
+
+def test_chaos_publisher_stall_schedule():
+    class _StubPub:
+        def __init__(self):
+            self.published = []
+
+        def publish(self, version, params):
+            self.published.append(version)
+
+        def close(self):
+            pass
+
+    slept = []
+    plan = ChaosConfig(1, {"stall_at": 2, "stall_s": 1.5}).plan_for(
+        "learner")
+    pub = ChaosParamPublisher(_StubPub(), plan, sleep=slept.append)
+    for v in range(5):
+        pub.publish(v, None)
+    assert pub.inner.published == [0, 1, 2, 3, 4]   # stall delays, never drops
+    assert slept == [1.5] and pub.stalls == 1
+
+
+def test_chaos_drop_frac_over_real_sockets():
+    """Dropped chunks consume no credit: with drop_frac=0.5 a
+    window-of-3 sender still completes 40 sends, and the receiver gets
+    exactly the non-dropped ones."""
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    comms = _comms()
+    recv = ChunkReceiver(comms, queue_depth=64)
+    recv.start()
+    try:
+        plan = ChaosConfig(11, {"drop_frac": 0.5}).plan_for("actor-0")
+        cs = ChaosChunkSender(ChunkSender(comms, "actor-0"), plan)
+        for i in range(40):
+            assert cs.send_chunk({"i": i})
+        assert 5 < cs.dropped < 35
+        expected = 40 - cs.dropped
+        got = []
+        deadline = time.monotonic() + 15
+        while len(got) < expected and time.monotonic() < deadline:
+            try:
+                got.append(recv.chunks.get(timeout=0.5))
+            except Exception:
+                pass
+        assert len(got) == expected
+        cs.close()
+    finally:
+        recv.stop()
+
+
+# -- park-and-rejoin --------------------------------------------------------
+
+def test_park_controller_parks_and_rejoins_respawned_learner():
+    """The whole drama in-process: params flow, the 'learner' dies (stops
+    publishing), the actor parks; a 'respawned learner' re-releases the
+    barrier and publishes — the parked actor reattaches in under a
+    second, its credit window reset, rejoins counted."""
+    from apex_tpu.runtime.transport import (ChunkSender, ParamPublisher,
+                                            ParamSubscriber,
+                                            barrier_release)
+
+    comms = _comms(park_after_s=0.3, rejoin_backoff_s=0.05,
+                   rejoin_backoff_max_s=0.2, rejoin_attempt_s=0.5)
+    stop = threading.Event()
+    sub = ParamSubscriber(comms)
+    sender = ChunkSender(comms, "actor-0")
+    park = ParkController(comms, "actor-0", stop, sub=sub, sender=sender)
+
+    pub1 = ParamPublisher(comms)
+    try:
+        time.sleep(0.2)                       # SUB connect (slow joiner)
+        pub1.publish(1, {"w": 1})
+        deadline = time.monotonic() + 5
+        got = None
+        while got is None and time.monotonic() < deadline:
+            got = sub.poll(100)
+        assert got is not None and got[0] == 1
+        park.note_params()
+        pub1.close()                          # learner dies
+
+        # wedge the window as an in-flight send would leave it
+        sender._in_flight = sender.max_outstanding
+
+        result = {}
+
+        def parked_actor():
+            result["got"] = park.park_and_rejoin()
+
+        t = threading.Thread(target=parked_actor, daemon=True)
+        time.sleep(0.4)                       # past park_after_s
+        assert park.stale()
+        t.start()
+        time.sleep(0.3)
+        assert park.parked
+
+        # respawned learner: barrier for 1 peer, then first publish
+        released = {}
+
+        def learner2():
+            released["n"] = barrier_release(comms, 1, timeout_s=10)
+            pub2 = ParamPublisher(comms)
+            try:
+                end = time.monotonic() + 5
+                while not result and time.monotonic() < end:
+                    pub2.publish(2, {"w": 2})
+                    time.sleep(0.05)
+            finally:
+                pub2.close()
+
+        lt = threading.Thread(target=learner2, daemon=True)
+        lt.start()
+        t.join(timeout=15)
+        lt.join(timeout=15)
+        assert not t.is_alive(), "actor never rejoined"
+        assert released["n"] == 1, "rejoin hello never reached the barrier"
+        assert result["got"] is not None and result["got"][0] >= 2
+        assert park.rejoins == 1 and not park.parked
+        assert sender._in_flight == 0, "credit window not reset on rejoin"
+        # the rejoin stashed the params for the adapter's next poll
+        assert park.take_pending() is not None
+        assert park.take_pending() is None
+    finally:
+        stop.set()
+        sender.close(drain_s=0)
+        sub.close()
+
+
+def test_park_controller_does_not_park_while_params_flow():
+    """Wedge-path false alarm guard: a backpressured-but-alive learner
+    keeps publishing, so park_and_rejoin probes, stashes the params, and
+    returns without parking or resetting credits."""
+    from apex_tpu.runtime.transport import ParamPublisher, ParamSubscriber
+
+    comms = _comms(park_after_s=0.2)
+    stop = threading.Event()
+    sub = ParamSubscriber(comms)
+    park = ParkController(comms, "actor-0", stop, sub=sub)
+    pub = ParamPublisher(comms)
+    try:
+        time.sleep(0.2)
+        pub.publish(5, {"w": 5})
+        time.sleep(0.3)                       # stale by clock, but a
+        got = park.park_and_rejoin()          # publish is waiting
+        assert got is not None and got[0] == 5
+        assert park.parks == 0 and park.rejoins == 0
+    finally:
+        stop.set()
+        pub.close()
+        sub.close()
+
+
+# -- status surface ---------------------------------------------------------
+
+def test_status_server_round_trip():
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    reg.observe(Heartbeat("actor-0", fps=123.0, param_version=4))
+    srv = FleetStatusServer(comms, reg)
+    srv.start()
+    try:
+        snap = status_request(comms, learner_ip="127.0.0.1", timeout_s=5)
+        assert snap is not None
+        assert snap["peers"][0]["identity"] == "actor-0"
+        assert snap["peers"][0]["fps"] == 123.0
+        assert snap["metrics"]["alive"] == 1
+    finally:
+        srv.stop()
+
+
+def test_status_cli_prints_fleet_table(capsys):
+    from apex_tpu.runtime import cli
+
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    reg.observe(Heartbeat("actor-2", role="actor", fps=55.0))
+    srv = FleetStatusServer(comms, reg)
+    srv.start()
+    try:
+        rc = cli.main(["--role", "status",
+                       "--status-port", str(comms.status_port)])
+        out = capsys.readouterr().out
+        assert rc in (0, None)
+        assert "actor-2" in out and "ALIVE" in out
+    finally:
+        srv.stop()
+
+
+# -- host supervisor --------------------------------------------------------
+
+def test_supervisor_respawn_budget_and_backoff():
+    """ActorPool semantics at process scale: short-lived crashes double
+    the backoff and burn budget; exhausting the budget halts with rc=1;
+    the respawn count is exported to each life."""
+    t = [0.0]
+    sleeps = []
+    lives = []
+
+    def fake_run(cmd, env):
+        lives.append(int(env["APEX_RESPAWN_COUNT"]))
+        t[0] += 1.0                     # every life dies after 1s
+        return 9
+
+    rc = supervise(["role"], max_respawns=3, window_s=600, min_uptime_s=60,
+                   backoff_s=1.0, backoff_max_s=4.0,
+                   sleep=sleeps.append, clock=lambda: t[0], run=fake_run)
+    assert rc == 1
+    assert lives == [0, 1, 2, 3]        # initial life + 3 budgeted respawns
+    assert len(sleeps) == 3
+    # exponential with jitter in [0.5, 1.5) of the doubling base
+    assert 1.0 <= sleeps[0] / 1.0 + 0.5 and sleeps[1] >= sleeps[0] * 0.5
+
+
+def test_supervisor_clean_exit_and_budget_refresh():
+    t = [0.0]
+
+    def run_clean(cmd, env):
+        t[0] += 120.0
+        return 0
+
+    assert supervise(["role"], run=run_clean, clock=lambda: t[0],
+                     sleep=lambda s: None) == 0
+
+    # long-lived lives never exhaust the budget: the window refreshes
+    calls = []
+
+    def run_long_then_clean(cmd, env):
+        calls.append(1)
+        t[0] += 700.0                   # outlives the window every time
+        return 0 if len(calls) >= 6 else 5
+
+    rc = supervise(["role"], max_respawns=2, window_s=600,
+                   min_uptime_s=60, run=run_long_then_clean,
+                   clock=lambda: t[0], sleep=lambda s: None)
+    assert rc == 0 and len(calls) == 6
+
+
+def test_supervisor_cli_subprocess_end_to_end():
+    """The real module entry: a child that always exits nonzero exhausts
+    a budget of 1 quickly; the supervisor reports and exits 1."""
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.fleet.supervise",
+         "--max-respawns", "1", "--min-uptime", "0.01",
+         "--backoff", "0.01", "--backoff-max", "0.02", "--",
+         sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1
+    assert "crash loop" in p.stdout
+
+
+def test_supervisor_cli_rejects_missing_command():
+    import subprocess
+    import sys
+
+    p = subprocess.run([sys.executable, "-m", "apex_tpu.fleet.supervise"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 2
+
+
+# -- adapters ---------------------------------------------------------------
+
+def test_socket_adapters_expose_fleet_hooks():
+    """The roles.py adapters surface wire counters and park state to the
+    worker loops' HeartbeatEmitter without the loops knowing about
+    sockets."""
+    from apex_tpu.runtime.roles import _ChunkQueueAdapter, _ParamQueueAdapter
+
+    comms = _comms()
+    stop = threading.Event()
+
+    class _Sub:
+        def poll(self, timeout_ms=0):
+            return None
+
+    sender = _StubSender()
+    park = ParkController(comms, "actor-0", stop, sub=_Sub(), sender=sender)
+    chunk_ad = _ChunkQueueAdapter(sender, stop, park=park)
+    param_ad = _ParamQueueAdapter(_Sub(), park=park)
+    assert chunk_ad.wire_counters() == {"chunks_sent": 0,
+                                        "acks_received": 0}
+    assert param_ad.park_state() == (False, 0)
+    chunk_ad.put(("chunk", 0, {"n": 1}))
+    assert sender.sent == [{"n": 1}]
+    assert chunk_ad.wire_counters()["chunks_sent"] == 1
