@@ -85,12 +85,21 @@ class ActorTimingStat:
 def drain_builder_chunks(builder) -> list[dict]:
     """FrameChunkBuilder chunks -> pool messages.  THE one place the chunk
     message shape is defined — every builder-based family (DQN scalar and
-    vector, pixel AQL scalar and vector) drains through here."""
+    vector, pixel AQL scalar and vector) drains through here.  Each
+    message is born with its lineage span ("sealed" hop — obs plane,
+    :mod:`apex_tpu.obs.spans`); the timestamps ride message METADATA
+    beside the payload, never inside it."""
+    from apex_tpu.obs import spans as obs_spans
+
+    stamped = obs_spans.enabled()
     out = []
     for chunk in builder.poll():
-        out.append({"payload": chunk,
-                    "priorities": chunk.pop("priorities"),
-                    "n_trans": int(chunk["n_trans"])})
+        msg = {"payload": chunk,
+               "priorities": chunk.pop("priorities"),
+               "n_trans": int(chunk["n_trans"])}
+        if stamped:
+            msg[obs_spans.SPAN_KEY] = [obs_spans.new_span(hop="sealed")]
+        out.append(msg)
     return out
 
 
@@ -151,9 +160,13 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     import jax
 
     from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+    from apex_tpu.obs import spans as obs_spans
+    from apex_tpu.obs.trace import get_ring, set_process_label
 
     key = jax.random.key(family.seed)
     env = family.env
+    set_process_label(f"actor-{actor_id}")
+    ring = get_ring()
     # fleet liveness: periodic Heartbeats on the stat channel — the
     # in-host trainer and the socket learner's registry consume the same
     # message (the socket adapters expose wire counters / park state)
@@ -219,7 +232,11 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
 
         for msg in family.poll_msgs():
             beat.note_chunk()
+            obs_spans.mark_send(msg, version)
+            t0 = time.perf_counter()
             chunk_queue.put(("chunk", actor_id, msg))     # blocks when full
+            ring.complete("chunk_put", t0, time.perf_counter() - t0,
+                          track="chunk-drain")
         if terminated or truncated:
             try:
                 stat_queue.put_nowait(

@@ -342,6 +342,18 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     import jax
 
     from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+    from apex_tpu.obs import spans as obs_spans
+    from apex_tpu.obs.trace import get_ring, set_process_label
+
+    set_process_label(f"actor-{actor_id}")
+    ring = get_ring()
+    # attach the trace ring to the family's existing timers: every
+    # policy-wait/env-step/drain phase and every dispatch gap becomes a
+    # trace event on this role's track (sampled, bounded, host-only)
+    family.phase.ring = ring
+    family.phase.track = "actor-phases"
+    family.gap.ring = ring
+    family.gap.track = "actor-dispatch"
 
     key = jax.random.key(family.seeds[0])
     beat = HeartbeatEmitter(
@@ -410,6 +422,7 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         with family.phase.phase("drain"):
             for msg in family.poll_msgs():
                 beat.note_chunk()
+                obs_spans.mark_send(msg, version)
                 chunk_queue.put(("chunk", actor_id, msg))  # blocks when full
 
     family.close()

@@ -853,6 +853,69 @@ class DeviceArrayOnMpQueue(Rule):
         return out
 
 
+# -- J010 -------------------------------------------------------------------
+
+
+#: span/ring emission calls of the obs plane (apex_tpu/obs) — host-side
+#: observability primitives that record NOTHING per call once traced
+_OBS_EMIT_NAMES = {"stamp", "stamp_spans", "mark_send"}
+_OBS_RING_METHODS = {"complete", "complete_wall", "instant"}
+
+
+@register
+class HostClockInJit(Rule):
+    id = "J010"
+    name = "host-clock-in-jit"
+    description = ("time.time()/time.perf_counter()/time.monotonic() (or an "
+                   "obs-plane span/ring emission) inside jit/shard_map "
+                   "trace scope: the clock reads at TRACE time, so every "
+                   "call sees the same frozen timestamp — and a span "
+                   "stamped there records nothing per step.  Hoist the "
+                   "measurement to the host loop around the dispatch "
+                   "(utils/profiling, apex_tpu/obs)")
+
+    def _clock_read(self, node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _TIMING_CALLS:
+            return f"{f.id}()"
+        if (isinstance(f, ast.Attribute) and f.attr in _TIMING_CALLS
+                and _attr_root(f) == "time"):
+            return f"time.{f.attr}()"
+        return None
+
+    def _obs_emit(self, node: ast.Call) -> str | None:
+        f = node.func
+        name = call_name(node) or ""
+        if name in _OBS_EMIT_NAMES:
+            return f"{name}()"
+        if (isinstance(f, ast.Attribute) and f.attr in _OBS_RING_METHODS):
+            recv = f.value
+            recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                         else recv.id if isinstance(recv, ast.Name) else "")
+            if "ring" in recv_name.lower():
+                return f"{recv_name}.{f.attr}()"
+        return None
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = ctx.in_jitted_scope(node)
+            if fn is None:
+                continue
+            what = self._clock_read(node) or self._obs_emit(node)
+            if what is None:
+                continue
+            out.append(ctx.finding(
+                self, node,
+                f"{what} inside jitted scope '{fn.name}' reads the host "
+                f"clock at trace time — the compiled program replays one "
+                f"frozen timestamp per compile; measure around the "
+                f"dispatch on the host loop instead"))
+        return out
+
+
 # -- J005 -------------------------------------------------------------------
 
 

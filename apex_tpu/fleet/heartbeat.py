@@ -38,6 +38,11 @@ class Heartbeat:
     rejoins: int = 0                # park -> resume cycles this process
     parked: bool = False
     dropped_stats: int = 0          # same carry semantics as EpisodeStat
+    # sender wall clock at beat creation (0.0 = unstamped): the learner's
+    # registry differences it against its own wall clock into a per-peer
+    # clock offset (skew + transit) — the alignment input for
+    # ``python -m apex_tpu.obs.merge`` cross-host trace merging
+    wall_ts: float = 0.0
 
 
 class HeartbeatEmitter:
@@ -91,4 +96,5 @@ class HeartbeatEmitter:
             param_version=int(param_version),
             chunks_sent=int(counters.get("chunks_sent", 0)),
             acks_received=int(counters.get("acks_received", 0)),
-            rejoins=int(rejoins), parked=bool(parked))
+            rejoins=int(rejoins), parked=bool(parked),
+            wall_ts=time.time())

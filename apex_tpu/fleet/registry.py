@@ -54,15 +54,20 @@ class PeerState:
     last_any: float = 0.0           # newest activity of either kind
     last_beat: float | None = None  # newest heartbeat (gap statistics)
     deaths: int = 0                 # ALIVE/SUSPECT -> DEAD transitions
+    # learner wall at receive - peer wall at send (skew + transit), from
+    # the heartbeat wall_ts; the obs.merge trace aligner consumes it via
+    # fleet_summary.json.  None until a wall-stamped beat arrives.
+    clock_offset_s: float | None = None
 
 
 class FleetRegistry:
     """Per-peer membership for one learner process."""
 
     def __init__(self, comms: CommsConfig | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, wall_clock=time.time):
         self.comms = comms or CommsConfig()
         self._clock = clock
+        self._wall = wall_clock
         self._lock = threading.Lock()
         self.peers: dict[str, PeerState] = {}
         self.dead_to_alive = 0          # registry-observed rejoins
@@ -107,6 +112,9 @@ class FleetRegistry:
             p.chunks_sent, p.acks_received = hb.chunks_sent, hb.acks_received
             p.rejoins_reported = max(p.rejoins_reported, hb.rejoins)
             p.parked = hb.parked
+            wall_ts = getattr(hb, "wall_ts", 0.0)
+            if wall_ts:
+                p.clock_offset_s = round(self._wall() - wall_ts, 4)
             p.beats += 1
             p.last_beat = p.last_any = now
 
@@ -198,6 +206,7 @@ class FleetRegistry:
                 "rejoins": p.rejoins_reported, "parked": p.parked,
                 "beats": p.beats, "deaths": p.deaths,
                 "silent_s": round(now - p.last_any, 1),
+                "clock_offset_s": p.clock_offset_s,
             } for _, p in sorted(self.peers.items())]
         return {"peers": peers, "metrics": self.metrics()}
 
@@ -229,14 +238,23 @@ class FleetStatusServer:
     Its own socket and its own thread — the ChunkReceiver's ROUTER stays
     single-threaded, and a status query can never block the data plane.
     zmq imports lazily so in-host trainers work without the comms extra.
+
+    Two request kinds on the one socket: any frame returns the pickled
+    registry snapshot (``--role status``); the frame ``b"metrics"``
+    returns Prometheus text exposition from ``metrics_fn`` (the
+    trainer's live scalars/rates/latency histograms —
+    :mod:`apex_tpu.obs.metrics`), so the fleet is pollable by standard
+    tooling.  Without a ``metrics_fn`` the metrics request degrades to a
+    fleet-only exposition rendered from the registry itself.
     """
 
     def __init__(self, comms: CommsConfig, registry: FleetRegistry,
-                 bind_ip: str = "*"):
+                 bind_ip: str = "*", metrics_fn=None):
         import zmq
 
         self._zmq = zmq
         self.registry = registry
+        self.metrics_fn = metrics_fn
         self.sock = zmq.Context.instance().socket(zmq.REP)
         self.sock.bind(f"tcp://{bind_ip}:{comms.status_port}")
         self._stop = threading.Event()
@@ -245,13 +263,27 @@ class FleetStatusServer:
     def start(self) -> None:
         self._thread.start()
 
+    def _metrics_text(self) -> str:
+        from apex_tpu.obs import metrics as obs_metrics
+        if self.metrics_fn is not None:
+            return self.metrics_fn()
+        gauges, labeled = obs_metrics.render_fleet(self.registry.snapshot())
+        return obs_metrics.render(gauges=gauges, labeled=labeled)
+
     def _run(self) -> None:
         from apex_tpu.runtime import wire
         while not self._stop.is_set():
             if not self.sock.poll(200, self._zmq.POLLIN):
                 continue
-            self.sock.recv()            # any request frame means "status"
-            self.sock.send(wire.dumps(self.registry.snapshot()))
+            req = self.sock.recv()
+            if req == b"metrics":
+                try:
+                    text = self._metrics_text()
+                except Exception as e:      # a scrape must never wedge REP
+                    text = f"# metrics unavailable: {type(e).__name__}\n"
+                self.sock.send(text.encode("utf-8", errors="replace"))
+            else:                       # any other frame means "status"
+                self.sock.send(wire.dumps(self.registry.snapshot()))
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,34 @@
+"""Experience-lifecycle tracing + metrics export (the obs plane).
+
+Three surfaces, one unit of account — a frame chunk:
+
+* :mod:`apex_tpu.obs.spans` — chunk lineage spans: compact
+  (monotonic, wall) timestamp pairs stamped into chunk-message METADATA
+  at each hop (sealed -> send -> recv -> merge -> stage -> consume ->
+  prio_wb), never into tensor payloads, so the merge/stack bit-parity
+  contracts of the ingest pipeline are untouched.  The learner joins
+  them against its publish-time ledger into the two headline
+  histograms: *frame-age-at-train* and *param-propagation-lag*.
+* :mod:`apex_tpu.obs.trace` — a bounded, sampled, host-only trace-event
+  ring per process, dumped as Chrome trace-event JSON (perfetto-loadable)
+  on exit, periodically, or on SIGUSR2; :mod:`apex_tpu.obs.merge` aligns
+  the per-process clocks (heartbeat-derived offsets when a
+  ``fleet_summary.json`` is present) into ONE fleet timeline.
+* :mod:`apex_tpu.obs.metrics` — Prometheus text exposition served from
+  the existing fleet-status REP server (port 52003), so MetricLogger
+  tails, rates, fleet states, and the latency histograms are pollable
+  by standard tooling.
+
+Everything here is stdlib-only and hot-loop-safe: clock reads and deque
+appends, no device syncs (apexlint J006) — and apexlint J010 flags any
+clock read or span emission that strays inside jit/shard_map scope.
+"""
+
+from apex_tpu.obs.spans import (HOPS, SPAN_KEY, LatencyHistogram,
+                                LearnerObs, mark_send, merge_spans,
+                                spans_of, stamp, stamp_spans)
+from apex_tpu.obs.trace import TraceRing, get_ring, set_process_label
+
+__all__ = ["HOPS", "SPAN_KEY", "LatencyHistogram", "LearnerObs",
+           "mark_send", "merge_spans", "spans_of", "stamp", "stamp_spans",
+           "TraceRing", "get_ring", "set_process_label"]

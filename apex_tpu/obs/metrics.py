@@ -1,0 +1,141 @@
+"""Prometheus text exposition for the fleet-status surface.
+
+The learner already serves registry snapshots on the status REP socket
+(port 52003, :class:`apex_tpu.fleet.registry.FleetStatusServer`); this
+module renders the same process's live state — MetricLogger history
+tails, RateCounter rates, fleet registry counts + per-peer gauges, and
+the obs-plane latency histograms — as Prometheus text exposition
+(version 0.0.4), served from that same socket for the ``b"metrics"``
+request frame.  ``python -m apex_tpu.runtime --role status --metrics``
+is the bundled scraper (one REQ round-trip, prints the text), and any
+tool that can issue the two-frame zmq REQ gets the same document — a
+fleet becomes pollable instead of only greppable from stdout and
+``fleet_summary.json``.
+"""
+
+from __future__ import annotations
+
+import re
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Metric-name-safe spelling of a scalar tag ("learner/loss" ->
+    "learner_loss")."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if f != f:
+        return "NaN"
+    return repr(f)
+
+
+def render(gauges: dict | None = None,
+           counters: dict | None = None,
+           histograms: dict | None = None,
+           labeled: dict | None = None,
+           prefix: str = "apex") -> str:
+    """Render one exposition document.
+
+    ``gauges`` / ``counters``: name -> value.
+    ``histograms``: name -> a :class:`~apex_tpu.obs.spans.LatencyHistogram`
+    snapshot dict (rendered as a Prometheus summary: quantile series +
+    ``_count``).
+    ``labeled``: name -> list of ``(label_dict, value)`` gauge rows
+    (per-peer fleet state).
+    """
+    lines: list[str] = []
+
+    def emit(name: str, kind: str, rows: list[str]) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(rows)
+
+    for name, value in sorted((gauges or {}).items()):
+        if value is None:
+            continue
+        emit(f"{prefix}_{sanitize(name)}", "gauge",
+             [f"{prefix}_{sanitize(name)} {_fmt(value)}"])
+    for name, value in sorted((counters or {}).items()):
+        if value is None:
+            continue
+        emit(f"{prefix}_{sanitize(name)}", "counter",
+             [f"{prefix}_{sanitize(name)} {_fmt(value)}"])
+    for name, snap in sorted((histograms or {}).items()):
+        base = f"{prefix}_{sanitize(name)}"
+        rows = [f'{base}{{quantile="{q}"}} {_fmt(snap.get(key))}'
+                for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                               ("0.99", "p99_s"))
+                if snap.get(key) is not None]
+        rows.append(f"{base}_count {int(snap.get('count', 0))}")
+        emit(base, "summary", rows)
+    for name, series in sorted((labeled or {}).items()):
+        base = f"{prefix}_{sanitize(name)}"
+        rows = []
+        for labels, value in series:
+            body = ",".join(
+                f'{sanitize(k)}="{str(v).replace(chr(34), "")}"'
+                for k, v in sorted(labels.items()))
+            rows.append(f"{base}{{{body}}} {_fmt(value)}")
+        if rows:
+            emit(base, "gauge", rows)
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet(snapshot: dict, prefix: str = "apex") -> tuple[dict, dict]:
+    """(gauges, labeled) sections from a FleetRegistry snapshot — shared
+    by the trainer's metrics_fn and the tests."""
+    m = snapshot.get("metrics", {})
+    gauges = {f"fleet_{k}": v for k, v in m.items() if v is not None}
+    labeled = {
+        "fleet_peer_up": [({"identity": p["identity"], "role": p["role"],
+                            "state": p["state"]},
+                           1.0 if p["state"] == "ALIVE" else 0.0)
+                          for p in snapshot.get("peers", [])],
+        "fleet_peer_fps": [({"identity": p["identity"]}, p.get("fps", 0.0))
+                           for p in snapshot.get("peers", [])],
+        "fleet_peer_chunks_sent": [({"identity": p["identity"]},
+                                    p.get("chunks_sent", 0))
+                                   for p in snapshot.get("peers", [])],
+    }
+    return gauges, labeled
+
+
+def scalar_tails(history: dict) -> dict:
+    """Latest value per MetricLogger tag (history is ``tag ->
+    deque[(step, value)]``; reads race benignly with the trainer's
+    appends — deque append/[-1] are GIL-atomic)."""
+    out = {}
+    for tag, dq in list(history.items()):
+        try:
+            out[tag] = dq[-1][1]
+        except (IndexError, TypeError):
+            continue
+    return out
+
+
+def metrics_request(comms, learner_ip: str | None = None,
+                    timeout_s: float = 5.0) -> str | None:
+    """Client half of the scrape: one REQ ``b"metrics"`` round-trip to
+    the learner's status server; the exposition text, or None when
+    nothing answers."""
+    import zmq
+
+    sock = zmq.Context.instance().socket(zmq.REQ)
+    ip = learner_ip or comms.learner_ip
+    sock.connect(f"tcp://{ip}:{comms.status_port}")
+    try:
+        sock.send(b"metrics")
+        if sock.poll(int(timeout_s * 1000), zmq.POLLIN):
+            return sock.recv().decode("utf-8", errors="replace")
+        return None
+    finally:
+        sock.close(linger=0)

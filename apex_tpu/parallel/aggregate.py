@@ -86,6 +86,13 @@ class ChunkAggregator:
                 break
             msgs, self._buf = self._buf[:self.n_dp], self._buf[self.n_dp:]
             payload, prios, n_trans = stack_chunk_messages(msgs)
-            out.append({"payload": payload, "priorities": prios,
-                        "n_trans": n_trans})
+            group = {"payload": payload, "priorities": prios,
+                     "n_trans": n_trans}
+            # lineage spans ride message metadata through the stacking
+            # (one span per source chunk, "merge" hop = group assembly)
+            from apex_tpu.obs import spans as obs_spans
+            spans = obs_spans.merge_spans(msgs)
+            if spans:
+                group[obs_spans.SPAN_KEY] = spans
+            out.append(group)
         return out

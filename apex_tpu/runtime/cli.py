@@ -127,6 +127,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup", type=int, default=50_000)
     p.add_argument("--alpha", type=float, default=0.6)
     p.add_argument("--beta", type=float, default=0.4)
+    # observability (apex_tpu/obs)
+    p.add_argument("--metrics", action="store_true",
+                   help="status role: print the Prometheus text "
+                        "exposition (scalars, rates, fleet, latency "
+                        "histograms) instead of the fleet table — one "
+                        "REQ round-trip to the learner's status server")
+    p.add_argument("--trace-dir", default=e.get("APEX_TRACE_DIR"),
+                   help="enable the per-role trace ring and dump Chrome "
+                        "trace-event JSON here (atexit/periodic/SIGUSR2); "
+                        "merge a fleet's dumps with "
+                        "`python -m apex_tpu.obs.merge DIR`")
     # misc
     p.add_argument("--logdir", default=e.get("APEX_LOGDIR"))
     p.add_argument("--profile-dir", default=e.get("APEX_PROFILE_DIR"),
@@ -208,6 +219,10 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.restore and not args.checkpoint_dir:
         raise SystemExit("--restore requires --checkpoint-dir")
+    if args.trace_dir:
+        # the trace ring reads the env at creation; the flag is its twin
+        # (exporting here also covers worker processes, which inherit it)
+        os.environ["APEX_TRACE_DIR"] = args.trace_dir
     cfg = config_from_args(args)
     identity = identity_from_args(args)
 
@@ -247,7 +262,18 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       barrier_timeout_s=args.barrier_timeout)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
-        # status server, rendered as the live membership table
+        # status server — the live membership table, or (--metrics) the
+        # Prometheus text exposition for standard scrape tooling
+        if args.metrics:
+            from apex_tpu.obs.metrics import metrics_request
+            text = metrics_request(cfg.comms, learner_ip=args.learner_ip)
+            if text is None:
+                print(f"no metrics from {args.learner_ip}:"
+                      f"{cfg.comms.status_port} (learner not running, or "
+                      f"an in-host trainer with no status server)")
+                return 1
+            print(text, end="")
+            return 0
         from apex_tpu.fleet.registry import format_fleet_table, \
             status_request
         snap = status_request(cfg.comms, learner_ip=args.learner_ip)

@@ -43,6 +43,7 @@ from dataclasses import dataclass
 import zmq
 
 from apex_tpu.config import CommsConfig
+from apex_tpu.obs import spans as obs_spans
 from apex_tpu.runtime import wire
 
 
@@ -292,6 +293,7 @@ class ChunkReceiver:
                     self.rejected += 1
                     continue
                 if kind == "chunk":
+                    obs_spans.stamp(body, "recv")   # lineage: wire arrival
                     with self._peers_lock:
                         self._chunk_senders.add(
                             ident.decode(errors="replace"))

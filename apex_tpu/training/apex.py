@@ -35,6 +35,7 @@ from apex_tpu.actors.pool import ActorPool, ActorTimingStat
 from apex_tpu.config import ApexConfig
 from apex_tpu.fleet.heartbeat import Heartbeat
 from apex_tpu.fleet.registry import FleetRegistry
+from apex_tpu.obs import spans as obs_spans
 from apex_tpu.parallel.aggregate import stack_chunk_messages
 from apex_tpu.envs.registry import (make_env, make_eval_env, num_actions,
                                     unstacked_env_spec)
@@ -116,11 +117,19 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # periodic fleet_summary.json lands
     fleet: FleetRegistry | None = None
     _fleet_status = None
+    # obs plane (apex_tpu/obs): the learner-side span join — publish-time
+    # ledger + frame-age-at-train / param-propagation-lag histograms +
+    # sampled chunk-lineage trace events (persists across train() calls
+    # like the checkpoint marks)
+    _obs = None
 
     # -- param plane -------------------------------------------------------
 
     def _publish(self) -> None:
         self.param_version += 1
+        if self._obs is not None:
+            # the param-propagation-lag join key: when THIS version left
+            self._obs.note_publish(self.param_version)
         if self._pipeline is not None:
             # hand the staging thread an on-device COPY: the hot loop's
             # next fused step donates train_state, which would invalidate
@@ -205,8 +214,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
         target_steps = self.steps_rate.total + total_steps
         if self.actor_timing is None:
             self.actor_timing = {}
+        from apex_tpu.obs.trace import get_ring, set_process_label
         from apex_tpu.utils.profiling import DispatchGapTimer
-        gap = self._dispatch_gap = DispatchGapTimer()
+        set_process_label("learner")
+        ring = get_ring()
+        if self._obs is None:
+            self._obs = obs_spans.LearnerObs(ring=ring)
+        gap = self._dispatch_gap = DispatchGapTimer(ring=ring,
+                                                    track="learner-hot-loop")
         pipeline = None
         if self._use_pipeline():
             from apex_tpu.training.ingest_pipeline import IngestPipeline
@@ -241,7 +256,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # surface, never to a dead learner)
             try:
                 from apex_tpu.fleet.registry import FleetStatusServer
-                self._fleet_status = FleetStatusServer(cfg.comms, self.fleet)
+                self._fleet_status = FleetStatusServer(
+                    cfg.comms, self.fleet, metrics_fn=self._metrics_text)
                 self._fleet_status.start()
             except Exception:
                 self._fleet_status = None
@@ -419,6 +435,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     if pipeline is not None:
                         extra |= {f"pipeline_{k}": v
                                   for k, v in pipeline.stats.items()}
+                    if self._obs is not None:
+                        extra |= self._obs.scalars()
                     self.log.scalars(
                         {k: float(v) for k, v in metrics.items()}
                         | {"bps": self.steps_rate.rate,
@@ -470,6 +488,51 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 mean([t.dispatch_gap_ms_p50 for t in ts]),
             "stat_drops": self.stat_drops,
         }
+
+    def latency_summary(self) -> dict | None:
+        """The e2e bench ``latency`` section: the chunk-lineage
+        histograms (frame-age-at-train, param-propagation-lag) plus the
+        hot-loop dispatch-gap percentiles, or None before train()."""
+        if self._obs is None:
+            return None
+        out = self._obs.summary()
+        if self._dispatch_gap is not None:
+            out["dispatch_gap_ms"] = self._dispatch_gap.snapshot()
+        return out
+
+    def _metrics_text(self) -> str:
+        """Prometheus exposition for the status server's ``b"metrics"``
+        request (runs on the server thread: every read here is either a
+        locked snapshot or a GIL-atomic tail read)."""
+        from apex_tpu.obs import metrics as obs_metrics
+
+        gauges = dict(obs_metrics.scalar_tails(self.log.history))
+        gauges["learner_steps_per_sec"] = self.steps_rate.rate
+        gauges["learner_frames_per_sec"] = self.frames_rate.rate
+        counters = {
+            "learner_steps_total": self.steps_rate.total,
+            "transitions_ingested_total": self.ingested,
+            "param_version": self.param_version,
+            "stat_drops_total": self.stat_drops,
+        }
+        labeled: dict = {}
+        if self.fleet is not None:
+            fleet_gauges, labeled = obs_metrics.render_fleet(
+                self.fleet.snapshot())
+            gauges.update(fleet_gauges)
+        histograms = {}
+        if self._obs is not None:
+            s = self._obs.summary()
+            histograms = {
+                "frame_age_at_train_seconds": s["frame_age_at_train_s"],
+                "param_propagation_lag_seconds":
+                    s["param_propagation_lag_s"],
+            }
+        if self._dispatch_gap is not None:
+            snap = self._dispatch_gap.snapshot()
+            gauges.update({f"learner_{k}": v for k, v in snap.items()})
+        return obs_metrics.render(gauges=gauges, counters=counters,
+                                  histograms=histograms, labeled=labeled)
 
     def fleet_summary(self) -> dict | None:
         """Registry snapshot + wire counters (the e2e bench ``fleet``
@@ -580,6 +643,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
         replay-ratio cap is re-checked at consume time, so a stale
         staging prediction can only under-train, never over-train)."""
         gap = self._dispatch_gap
+        obs = self._obs
+        if obs is not None and slot.spans:
+            obs.pre_consume(slot.spans)     # "consume": dispatch issued
         metrics = None
         if slot.kind == "scan":
             j = slot.chunks
@@ -628,6 +694,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
             self.replay_state = self._ingest(self.replay_state,
                                              slot.payload, slot.prios)
             gap.dispatch_returned()
+        if obs is not None and slot.spans:
+            obs.post_consume(slot.spans)    # "prio_wb" + the two joins
         self.ingested += slot.n_trans
         self.frames_rate.tick(slot.n_trans)
         return metrics
@@ -637,6 +705,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
         """The serial (pipeline-off) drain of one poll's messages.
         Returns metrics or None."""
         gap = self._dispatch_gap
+        obs = self._obs
+        if obs is not None:
+            for m in msgs:
+                obs_spans.stamp(m, "recv")  # no staging thread: poll=recv
         metrics = None
         if want > 1 and len(msgs) > 1:
             # scan batch: j chunks -> one device dispatch, quantized to a
@@ -650,17 +722,22 @@ class ConcurrentTrainer(CheckpointableTrainer):
             j = _pow2_floor(len(msgs))
             take, msgs = msgs[:j], msgs[j:]
             payload, prios, n_new = stack_chunk_messages(take)
+            spans = obs_spans.merge_spans(take) if obs is not None else ()
             n_per = np.asarray([int(m["n_trans"]) for m in take])
             offsets = np.concatenate([[0], np.cumsum(n_per)[:-1]])
             betas = np.asarray(
                 [self._beta(self.ingested + int(o))
                  for o in offsets], np.float32)
             k = self._dispatch_key()
+            if spans:
+                obs.pre_consume(spans)
             gap.about_to_dispatch()
             self.train_state, self.replay_state, mm = \
                 self._multi(self.train_state, self.replay_state,
                             payload, prios, jax.random.split(k, j), betas)
             gap.dispatch_returned()
+            if spans:
+                obs.post_consume(spans)
             # scalar observability coarsens to per-dispatch under scan:
             # report the mean over the j stacked steps
             metrics = jax.tree.map(lambda x: x.mean(0), mm)
@@ -673,6 +750,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
             prios = jnp.asarray(msg["priorities"])
             n_new = int(msg["n_trans"])
             payload = msg["payload"]
+            spans = obs_spans.spans_of(msg) if obs is not None else ()
+            if spans:
+                obs.pre_consume(spans)
             # The replay-ratio cap applies on the chunk path too: an
             # over-budget learner ingests WITHOUT the fused train half,
             # so the documented ``train_ratio`` really is the ceiling
@@ -691,6 +771,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 self.replay_state = self._ingest(
                     self.replay_state, payload, prios)
                 gap.dispatch_returned()
+            if spans:
+                obs.post_consume(spans)
             self.ingested += n_new
             self.frames_rate.tick(n_new)
         return metrics

@@ -85,6 +85,9 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from apex_tpu.obs import spans as obs_spans
+from apex_tpu.obs.trace import get_ring
+
 #: payload keys that identify a self-contained frame chunk
 #: (replay/frame_chunks.py contract) — the only payload schema
 #: merge_chunk_messages understands.  Everything else (stacked AQL
@@ -172,7 +175,14 @@ def merge_chunk_messages(msgs: list[dict]) -> dict:
             for name in payloads[0]["extras"]}
     prios = cat([np.asarray(msg["priorities"])[:nt]
                  for msg, nt in zip(msgs, n_tr)], np.float32)
-    return {"payload": payload, "priorities": prios, "n_trans": tot_tr}
+    out = {"payload": payload, "priorities": prios, "n_trans": tot_tr}
+    # lineage spans ride MESSAGE metadata, never the payload — the
+    # bit-parity contract above compares payloads field for field and
+    # must keep holding with stamping on (tests re-pin it)
+    spans = obs_spans.merge_spans(msgs)
+    if spans:
+        out[obs_spans.SPAN_KEY] = spans
+    return out
 
 
 def merge_group_messages(msgs: list[dict], n_dp: int) -> dict:
@@ -204,8 +214,12 @@ def merge_group_messages(msgs: list[dict], n_dp: int) -> dict:
         *[p["payload"] for p in per_shard])
     prios = np.stack([np.asarray(p["priorities"], np.float32)
                       for p in per_shard])
-    return {"payload": payload, "priorities": prios,
-            "n_trans": sum(int(p["n_trans"]) for p in per_shard)}
+    out = {"payload": payload, "priorities": prios,
+           "n_trans": sum(int(p["n_trans"]) for p in per_shard)}
+    spans = obs_spans.merge_spans(msgs)    # metadata, not payload (above)
+    if spans:
+        out[obs_spans.SPAN_KEY] = spans
+    return out
 
 
 class KeyPrefetcher:
@@ -290,6 +304,9 @@ class StagedSlot:
     #: behind an unconsumed trainable slot see the step count they will
     #: actually meet at the front of the queue
     planned_steps: int = 0
+    #: lineage spans of the slot's source chunks (obs plane metadata —
+    #: the trainer joins them into frame-age / param-lag at consume)
+    spans: tuple = ()
 
 
 def _pow2_floor(n: int) -> int:
@@ -362,6 +379,9 @@ class IngestPipeline:
         self._staged_steps = 0          # planned train steps not yet consumed
         self.stats = {"slots": 0, "scan_slots": 0, "merged_slots": 0,
                       "merged_chunks": 0, "publishes": 0}
+        # obs plane: staging-thread activity lands on its own track of
+        # the learner's trace ring (host clocks only — J006/J010 clean)
+        self.ring = get_ring()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="apex-ingest-staging")
 
@@ -460,7 +480,11 @@ class IngestPipeline:
                     self._idle.set()
                     continue
                 self._idle.clear()
+                t0 = time.perf_counter()
                 slot = self._build_slot(msgs[0], st)
+                self.ring.complete(f"stage_{slot.kind}", t0,
+                                   time.perf_counter() - t0,
+                                   track="ingest-staging")
                 self._put(slot)
         except BaseException as exc:      # surface to poll_slot, loudly
             self._error = exc
@@ -473,6 +497,10 @@ class IngestPipeline:
             with self._ahead_lock:
                 self._staged_ahead += n_trans
                 self._polled_total += n_trans
+            for m in msgs:
+                # first-wins: the socket receiver's decode stamp (truer)
+                # survives; mp-queue chunks get their recv time here
+                obs_spans.stamp(m, "recv")
         return msgs
 
     def _build_slot(self, first: dict, st: PipelineState) -> StagedSlot:
@@ -505,11 +533,13 @@ class IngestPipeline:
             slot = self._single_slot(take[0])
         else:
             payload, prios, n_new = stack_chunk_messages(take)
+            spans = obs_spans.merge_spans(take)     # scan stack = merge hop
+            obs_spans.stamp_spans(spans, "stage")
             slot = StagedSlot(
                 kind="scan", payload=self._stage(payload),
                 prios=self._stage(prios), n_trans=n_new,
                 n_per=tuple(int(m["n_trans"]) for m in take), chunks=j,
-                planned_steps=j)
+                planned_steps=j, spans=tuple(spans))
             with self._ahead_lock:
                 self._staged_steps += j
             self.stats["scan_slots"] += 1
@@ -551,11 +581,14 @@ class IngestPipeline:
             self.stats["merged_slots"] += 1
             self.stats["merged_chunks"] += j
             self.stats["slots"] += 1
+            spans = obs_spans.spans_of(merged)      # merge hop stamped there
+            obs_spans.stamp_spans(spans, "stage")
             slot = StagedSlot(
                 kind="merged", payload=self._stage(merged["payload"]),
                 prios=self._stage(np.asarray(merged["priorities"],
                                              np.float32)),
-                n_trans=int(merged["n_trans"]), chunks=j)
+                n_trans=int(merged["n_trans"]), chunks=j,
+                spans=tuple(spans))
         return slot
 
     def _single_slot(self, msg: dict, planned: int = 1) -> StagedSlot:
@@ -563,10 +596,13 @@ class IngestPipeline:
         if planned:
             with self._ahead_lock:
                 self._staged_steps += planned
+        spans = obs_spans.spans_of(msg)
+        obs_spans.stamp_spans(spans, "stage")
         return StagedSlot(
             kind="single", payload=self._stage(msg["payload"]),
             prios=self._stage(np.asarray(msg["priorities"], np.float32)),
-            n_trans=int(msg["n_trans"]), planned_steps=planned)
+            n_trans=int(msg["n_trans"]), planned_steps=planned,
+            spans=tuple(spans))
 
     def _merge_cap(self, payload) -> int:
         """Max chunks (dp>1: groups) mergeable with ``payload`` as the
@@ -606,6 +642,9 @@ class IngestPipeline:
         if req is None:
             return
         version, params = req
+        t0 = time.perf_counter()
         host_params = jax.device_get(params)
         self.pool.publish_params(version, host_params)
         self.stats["publishes"] += 1
+        self.ring.complete("publish", t0, time.perf_counter() - t0,
+                           track="ingest-staging", args={"version": version})

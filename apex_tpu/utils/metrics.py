@@ -8,9 +8,24 @@ BASELINE.json primary metric."""
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from typing import Any
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of a PRE-SORTED sequence: the smallest
+    element with at least ``q`` of the mass at or below it
+    (``ceil(q*n) - 1``).  For an even-length median this is the LOWER
+    middle element — the naive ``vals[n // 2]`` picks the upper one,
+    which biases short windows upward (the DispatchGapTimer defect this
+    replaced).  Returns 0.0 on empty input.  Pure stdlib — the obs plane
+    imports it from worker processes before JAX initializes."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    return sorted_vals[max(0, math.ceil(q * n) - 1)]
 
 
 class RateCounter:

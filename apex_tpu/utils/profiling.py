@@ -21,6 +21,8 @@ from typing import Iterator
 
 import jax
 
+from apex_tpu.utils.metrics import percentile  # noqa: F401 (re-export)
+
 # bf16 peak FLOPs/s per chip for common TPU generations (public specs);
 # bench/callers can override explicitly.
 PEAK_FLOPS = {
@@ -72,11 +74,18 @@ class PhaseTimer:
 
     Pure host timing — never touches the device, so it is safe on the hot
     loop.
+
+    ``ring``: an optional :class:`apex_tpu.obs.trace.TraceRing` — when
+    attached, every completed phase also lands in the per-role trace ring
+    as one Chrome trace event (host clock reads only; apexlint J006/J010
+    stay clean).
     """
 
-    def __init__(self):
+    def __init__(self, ring=None, track: str | None = None):
         self._acc: dict[str, float] = {}
         self._t0 = time.perf_counter()
+        self.ring = ring
+        self.track = track
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -84,7 +93,10 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t)
+            dur = time.perf_counter() - t
+            self.add(name, dur)
+            if self.ring is not None:
+                self.ring.complete(name, t, dur, track=self.track)
 
     def add(self, name: str, seconds: float) -> None:
         self._acc[name] = self._acc.get(name, 0.0) + seconds
@@ -118,38 +130,48 @@ class DispatchGapTimer:
     there).
     """
 
-    def __init__(self, window: int = 512):
+    def __init__(self, window: int = 512, ring=None,
+                 track: str | None = None):
         self._last_return: float | None = None
         self._gaps: deque[float] = deque(maxlen=window)
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        # optional obs.trace ring: each measured gap becomes one
+        # "host_gap" trace event (host timing only)
+        self.ring = ring
+        self.track = track
 
     def about_to_dispatch(self) -> None:
         """Call immediately before issuing a device dispatch."""
         if self._last_return is None:
             return
-        gap = time.perf_counter() - self._last_return
+        t0 = self._last_return
+        gap = time.perf_counter() - t0
         self._gaps.append(gap)
         self.count += 1
         self.total += gap
         if gap > self.max:
             self.max = gap
         self._last_return = None
+        if self.ring is not None:
+            self.ring.complete("host_gap", t0, gap, track=self.track)
 
     def dispatch_returned(self) -> None:
         """Call immediately after the dispatch call returns."""
         self._last_return = time.perf_counter()
 
     def snapshot(self) -> dict:
-        """Non-mutating stats dict (ms units; p50 over the last
-        ``window`` gaps) — callers may sample it at any cadence."""
+        """Non-mutating stats dict (ms units; nearest-rank percentiles
+        over the last ``window`` gaps) — callers may sample it at any
+        cadence."""
         gaps = sorted(self._gaps)
-        p50 = gaps[len(gaps) // 2] if gaps else 0.0
         return {
             "dispatch_gap_ms_mean":
                 1000.0 * self.total / self.count if self.count else 0.0,
-            "dispatch_gap_ms_p50": 1000.0 * p50,
+            "dispatch_gap_ms_p50": 1000.0 * percentile(gaps, 0.50),
+            "dispatch_gap_ms_p90": 1000.0 * percentile(gaps, 0.90),
+            "dispatch_gap_ms_p99": 1000.0 * percentile(gaps, 0.99),
             "dispatch_gap_ms_max": 1000.0 * self.max,
             "dispatches": self.count,
         }

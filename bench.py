@@ -997,6 +997,9 @@ def bench_end_to_end(e2e_seconds: float) -> dict:
     # (~20-40s of the wall budget) would otherwise dominate the average
     return {"env": env_id,
             "steady": steady,
+            # obs plane: frame-age-at-train / param-propagation-lag
+            # histograms (p50/p90/p99) + hot-loop dispatch-gap percentiles
+            "latency": trainer.latency_summary(),
             "obs_geometry": geometry,
             "env_frames_per_sec": round(trainer.frames_rate.rate, 1),
             "learner_steps_per_sec": round(trainer.steps_rate.rate, 2),

@@ -24,6 +24,17 @@ export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 #   CHAOS_SEED=7 CHAOS_SPEC='{"kill":{"actor-0":200},"drop_frac":0.05}' \
 #     scripts/run_local.sh
 export CHAOS_SEED="${CHAOS_SEED:-}" CHAOS_SPEC="${CHAOS_SPEC:-}"
+
+# Observability (apex_tpu/obs): every role dumps a per-process trace ring
+# (chunk lineage spans, phase/gap events) into APEX_TRACE_DIR — dumped on
+# exit AND flushed periodically, so the actors killed by the EXIT trap
+# still leave near-complete traces.  The learner's fleet_summary.json
+# lands in the same dir, giving obs.merge the heartbeat-derived clock
+# offsets for the single merged perfetto timeline.
+TRACE_DIR="${APEX_TRACE_DIR:-/tmp/apex-obs-$$}"
+export APEX_TRACE_DIR="$TRACE_DIR"
+mkdir -p "$TRACE_DIR"
+
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
         --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500
@@ -44,4 +55,10 @@ pids+=($!)
 
 # learner runs in the foreground; barrier holds until every peer dials in
 python -m apex_tpu.runtime --role learner --total-steps "$TOTAL_STEPS" \
-  --verbose "${COMMON[@]}"
+  --verbose --logdir "$TRACE_DIR" "${COMMON[@]}"
+
+# one perfetto-loadable fleet timeline (clock-aligned via the heartbeat
+# offsets in fleet_summary.json); load it at https://ui.perfetto.dev
+sleep 1   # let the periodic flushers land their last dumps
+python -m apex_tpu.obs.merge "$TRACE_DIR" \
+  -o "$TRACE_DIR/merged_trace.json" || true

@@ -873,6 +873,88 @@ def test_j009_silent_on_host_data_and_non_queues():
         """, "J009")
 
 
+# -- J010: host clocks / obs span emission inside jitted scope ---------------
+
+def test_j010_fires_on_clock_read_in_jitted_step():
+    # the obs-plane hazard: a timestamp read inside the compiled program
+    # traces to ONE frozen constant per compile
+    assert fires("""
+        import time
+        import jax
+        @jax.jit
+        def fused_step(ts, rs, chunk):
+            t0 = time.perf_counter()
+            return update(ts, rs, chunk), t0
+        """, "J010")
+    assert fires("""
+        import jax
+        from time import monotonic
+        def train_step(ts, batch):
+            started = monotonic()
+            return apply(ts, batch), started
+        step = jax.jit(train_step)
+        """, "J010")
+
+
+def test_j010_fires_on_span_emission_in_jitted_scope():
+    assert fires("""
+        import jax
+        from apex_tpu.obs import spans as obs_spans
+        @jax.jit
+        def fused_step(ts, rs, msg):
+            stamp(msg, "consume")
+            return update(ts, rs, msg)
+        """, "J010")
+    assert fires("""
+        import jax
+        @jax.jit
+        def train_step(ts, batch, ring):
+            ring.complete("x", 0.0, 0.1)
+            return apply(ts, batch)
+        """, "J010")
+
+
+def test_j010_silent_on_host_loop_timing():
+    # the sanctioned shape: clocks around the dispatch, on the host loop
+    assert not fires("""
+        import time
+        import jax
+        step = jax.jit(fused)
+        def drive(ts, chunks):
+            for chunk in chunks:
+                t0 = time.perf_counter()
+                ts = step(ts, chunk)
+                record(time.perf_counter() - t0)
+        """, "J010")
+    # span stamping at the host consume site is exactly the design
+    assert not fires("""
+        import jax
+        from apex_tpu.obs import spans as obs_spans
+        step = jax.jit(fused)
+        def consume(ts, slot):
+            obs_spans.stamp_spans(slot.spans, "consume")
+            return step(ts, slot.payload)
+        """, "J010")
+
+
+def test_j010_silent_on_non_time_receivers():
+    # x.time() on an arbitrary receiver is not a clock read
+    assert not fires("""
+        import jax
+        @jax.jit
+        def fused_step(ts, sched):
+            return ts, sched.time(3)
+        """, "J010")
+    # .complete on a non-ring receiver is out of scope
+    assert not fires("""
+        import jax
+        @jax.jit
+        def train_step(ts, task):
+            task.complete("done", 0, 1)
+            return ts
+        """, "J010")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

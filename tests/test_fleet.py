@@ -467,6 +467,62 @@ def test_status_cli_prints_fleet_table(capsys):
         srv.stop()
 
 
+def test_metrics_scrape_round_trip():
+    """The Prometheus surface on the SAME status REP socket: b"metrics"
+    returns text exposition (trainer-provided metrics_fn, or the
+    registry-only fallback), and the pickled snapshot path keeps working
+    beside it."""
+    from apex_tpu.obs.metrics import metrics_request
+
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    reg.observe(Heartbeat("actor-0", role="actor", fps=88.0, wall_ts=1.0))
+
+    calls = []
+
+    def metrics_fn():
+        calls.append(1)
+        return ("# TYPE apex_fleet_alive gauge\n"
+                "apex_fleet_alive 1.0\n"
+                "apex_custom_gauge 42.0\n")
+
+    srv = FleetStatusServer(comms, reg, metrics_fn=metrics_fn)
+    srv.start()
+    try:
+        text = metrics_request(comms, learner_ip="127.0.0.1", timeout_s=5)
+        assert text is not None and calls == [1]
+        assert "apex_fleet_alive 1.0" in text
+        assert "apex_custom_gauge 42.0" in text
+        # the snapshot request still answers on the same socket
+        snap = status_request(comms, learner_ip="127.0.0.1", timeout_s=5)
+        assert snap is not None
+        assert snap["peers"][0]["identity"] == "actor-0"
+        assert snap["peers"][0]["clock_offset_s"] is not None
+    finally:
+        srv.stop()
+
+
+def test_metrics_scrape_registry_fallback_and_cli(capsys):
+    """Without a metrics_fn the server renders a fleet-only exposition
+    from the registry; `--role status --metrics` prints it."""
+    from apex_tpu.runtime import cli
+
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    reg.observe(Heartbeat("actor-3", role="actor", fps=12.0))
+    srv = FleetStatusServer(comms, reg)
+    srv.start()
+    try:
+        rc = cli.main(["--role", "status", "--metrics",
+                       "--status-port", str(comms.status_port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "# TYPE apex_fleet_alive gauge" in out
+        assert 'apex_fleet_peer_fps{identity="actor-3"} 12.0' in out
+    finally:
+        srv.stop()
+
+
 # -- host supervisor --------------------------------------------------------
 
 def test_supervisor_respawn_budget_and_backoff():
