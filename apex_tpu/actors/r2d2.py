@@ -110,11 +110,24 @@ def drain_grouped(ready: list[dict], group: int,
     groups stay buffered for the next drain.  Shared by the scalar and
     vector worker families and the single-process driver.
     ``message_fn`` picks the layout: :func:`sequence_message` (stacked)
-    or :func:`pooled_sequence_message` (frame-dedup pool)."""
+    or :func:`pooled_sequence_message` (frame-dedup pool).
+
+    Each message is born with its lineage span ("sealed" hop), exactly
+    like the frame-chunk families' ``drain_builder_chunks`` — so the
+    recurrent family is visible in the merged fleet timeline too.  The
+    span rides message METADATA beside the payload, never inside it
+    (the learner's sequence-batch shapes and the obs-plane bit-parity
+    discipline both depend on that)."""
+    from apex_tpu.obs import spans as obs_spans
+
+    stamped = obs_spans.enabled()
     out = []
     while len(ready) >= group:
         take, ready[:] = ready[:group], ready[group:]
-        out.append(message_fn(take))
+        msg = message_fn(take)
+        if stamped:
+            msg[obs_spans.SPAN_KEY] = [obs_spans.new_span(hop="sealed")]
+        out.append(msg)
     return out
 
 

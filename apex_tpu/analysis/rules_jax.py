@@ -916,6 +916,110 @@ class HostClockInJit(Rule):
         return out
 
 
+# -- J011 -------------------------------------------------------------------
+
+
+#: the canonical fleet mesh axes, as declared by
+#: apex_tpu.parallel.mesh.make_mesh — modules that import from that
+#: module inherit these as their declared axis vocabulary
+_CANONICAL_MESH_AXES = frozenset({"dp", "tp"})
+
+_SPEC_CTORS = {"P", "PartitionSpec"}
+_SHARD_MAP_NAMES = {"shard_map", "shard_map_compat", "pjit"}
+
+
+@register
+class ShardingAnnotationDrift(Rule):
+    id = "J011"
+    name = "sharding-annotation-drift"
+    description = ("a PartitionSpec axis name in pjit/shard_map "
+                   "in/out shardings that no declared mesh axis matches "
+                   "(parallel/mesh.py declares ('dp', 'tp')): the spec "
+                   "silently stops sharding — or errors at dispatch — "
+                   "when the annotation drifts from the mesh")
+
+    def _declared_axes(self, ctx: ModuleContext) -> frozenset[str] | None:
+        """Axis names this module's meshes declare: literal axis-name
+        tuples in ``Mesh(...)`` constructions, plus the canonical
+        ``make_mesh`` axes when the module uses apex_tpu.parallel.mesh.
+        None = no mesh vocabulary in scope -> the rule stays silent (it
+        judges drift, not style)."""
+        axes: set[str] = set()
+        canonical = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module and node.module.endswith("parallel.mesh"):
+                    canonical = True
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name == "make_mesh":
+                    canonical = True
+                elif name == "Mesh":
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        if isinstance(arg, (ast.Tuple, ast.List)):
+                            names = [e.value for e in arg.elts
+                                     if isinstance(e, ast.Constant)
+                                     and isinstance(e.value, str)]
+                            if names and len(names) == len(arg.elts):
+                                axes.update(names)
+        if canonical:
+            axes.update(_CANONICAL_MESH_AXES)
+        return frozenset(axes) if axes else None
+
+    def _spec_axis_names(self, call: ast.Call):
+        """(axis_name, node) pairs of the string constants a
+        P/PartitionSpec construction mentions (nested tuples included:
+        ``P(("dp", "tp"))`` shards one dim over both axes)."""
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    yield n.value, n
+
+    def _annotation_scope(self, ctx: ModuleContext,
+                          call: ast.Call) -> str | None:
+        """The sharding-annotation surface ``call`` sits on, or None.
+        Surfaces: in_specs/out_specs of shard_map (+compat) and
+        in_shardings/out_shardings of jit/pjit — directly, or via a
+        NamedSharding wrapping this spec anywhere (a NamedSharding is
+        always a placement against a concrete mesh)."""
+        for a in ctx.ancestors(call):
+            if isinstance(a, ast.Call):
+                name = call_name(a) or ""
+                if name == "NamedSharding":
+                    return "NamedSharding"
+                if name in _SHARD_MAP_NAMES or is_jit_expr(a.func):
+                    for kw in a.keywords:
+                        if kw.arg in ("in_specs", "out_specs",
+                                      "in_shardings", "out_shardings") \
+                                and call in ast.walk(kw.value):
+                            return f"{name}({kw.arg}=...)"
+        return None
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        declared = self._declared_axes(ctx)
+        if declared is None:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in _SPEC_CTORS):
+                continue
+            scope = self._annotation_scope(ctx, node)
+            if scope is None:
+                continue
+            for axis, at in self._spec_axis_names(node):
+                if axis not in declared:
+                    out.append(ctx.finding(
+                        self, at,
+                        f"PartitionSpec axis {axis!r} in {scope} matches "
+                        f"no declared mesh axis {sorted(declared)} — the "
+                        f"annotation drifted from the mesh "
+                        f"(parallel/mesh.py); rename the axis or declare "
+                        f"it on the Mesh"))
+        return out
+
+
 # -- J005 -------------------------------------------------------------------
 
 

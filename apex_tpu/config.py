@@ -244,6 +244,28 @@ class CommsConfig:
     rejoin_backoff_s: float = 1.0    # first retry delay (doubles per miss)
     rejoin_backoff_max_s: float = 8.0
     rejoin_attempt_s: float = 5.0    # per-attempt barrier/param race window
+    # -- sharded replay service (apex_tpu/replay_service) ------------------
+    # 0 = in-learner replay (replay dissolved into the learner's HBM, the
+    # default since PR 0).  N > 0 restores the reference's standalone
+    # replay role (origin_repo/replay.py) as N shard processes: actors
+    # hash sealed chunks to shards (stable chunk-id hash, per-shard
+    # credit window), each shard owns one FramePoolReplay segment tree
+    # and serves pre-sampled batches, and the learner pulls round-robin
+    # + ships priority write-backs to the owning shard.
+    replay_shards: int = 0
+    # shard s binds ONE ROUTER at replay_port_base + s (chunk ingest from
+    # actors AND pull/prio traffic from the learner multiplex on it)
+    replay_port_base: int = 53001
+    # strict: a shard samples batch j+1 only after batch j's priority
+    # write-back lands (and defers the next ingest behind it), so the
+    # shard replays the exact in-learner ingest->sample->write-back
+    # interleave — N=1 is bit-identical to in-learner replay (pinned in
+    # tests/test_replay_service.py).  False = the reference's loose
+    # semantics: pre-sample ahead, apply write-backs whenever they land.
+    replay_strict_order: bool = True
+    # loose-mode pre-sample depth (batches staged ahead of the learner's
+    # pulls); strict mode is structurally depth-1
+    replay_presample: int = 2
 
 
 @dataclass(frozen=True)

@@ -36,6 +36,21 @@ from apex_tpu.fleet.heartbeat import Heartbeat
 JOINING, ALIVE, SUSPECT, DEAD = "JOINING", "ALIVE", "SUSPECT", "DEAD"
 
 
+def _min_transit_offset(samples) -> float:
+    """Per-peer clock offset from recent heartbeat samples: the median of
+    the smallest half (min-transit selection).  Each sample is
+    ``skew + transit_i`` with ``transit_i >= 0``, so the smallest samples
+    bound the skew most tightly; the median over that low half keeps one
+    anomalous beat (queue dwell spike, clock step mid-window) from owning
+    the estimate the way last-beat sampling did."""
+    s = sorted(samples)
+    low = s[:max(1, len(s) // 2)]
+    mid = len(low) // 2
+    med = (low[mid] if len(low) % 2
+           else (low[mid - 1] + low[mid]) / 2.0)
+    return round(med, 4)
+
+
 @dataclass
 class PeerState:
     identity: str
@@ -54,10 +69,18 @@ class PeerState:
     last_any: float = 0.0           # newest activity of either kind
     last_beat: float | None = None  # newest heartbeat (gap statistics)
     deaths: int = 0                 # ALIVE/SUSPECT -> DEAD transitions
-    # learner wall at receive - peer wall at send (skew + transit), from
-    # the heartbeat wall_ts; the obs.merge trace aligner consumes it via
-    # fleet_summary.json.  None until a wall-stamped beat arrives.
+    # learner wall at receive - peer wall at send (skew + one transit),
+    # from the heartbeat wall_ts; the obs.merge trace aligner consumes it
+    # via fleet_summary.json.  None until a wall-stamped beat arrives.
+    # Each sample overestimates the true skew by that beat's transit (+
+    # any stat-queue dwell), so the published offset is NOT the last beat
+    # but a min-transit median over the recent sample window: transit is
+    # strictly additive, so the smallest samples are the closest to pure
+    # skew, and the median over that low half rides out one lucky/broken
+    # outlier (NTP's clock-filter idea, scaled down).
     clock_offset_s: float | None = None
+    clock_offset_n: int = 0         # samples behind the estimate
+    offset_samples: deque = field(default_factory=lambda: deque(maxlen=16))
 
 
 class FleetRegistry:
@@ -114,7 +137,9 @@ class FleetRegistry:
             p.parked = hb.parked
             wall_ts = getattr(hb, "wall_ts", 0.0)
             if wall_ts:
-                p.clock_offset_s = round(self._wall() - wall_ts, 4)
+                p.offset_samples.append(self._wall() - wall_ts)
+                p.clock_offset_s = _min_transit_offset(p.offset_samples)
+                p.clock_offset_n = len(p.offset_samples)
             p.beats += 1
             p.last_beat = p.last_any = now
 
@@ -207,6 +232,7 @@ class FleetRegistry:
                 "beats": p.beats, "deaths": p.deaths,
                 "silent_s": round(now - p.last_any, 1),
                 "clock_offset_s": p.clock_offset_s,
+                "clock_offset_n": p.clock_offset_n,
             } for _, p in sorted(self.peers.items())]
         return {"peers": peers, "metrics": self.metrics()}
 

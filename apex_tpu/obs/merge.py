@@ -11,12 +11,17 @@ concatenation:
 * **Clock alignment.**  Wall clocks agree on one host but skew across
   hosts.  The learner's registry already measures each peer's offset
   from the heartbeat timestamps flowing through
-  :mod:`apex_tpu.fleet.heartbeat` (``clock_offset_s`` =
-  learner-wall-at-receive - peer-wall-at-send, i.e. skew + transit) and
-  persists it in ``fleet_summary.json``; when a summary is given (or
-  found next to the traces), each file whose label matches a peer
-  identity is shifted onto the learner's timeline.  Files without a
-  matching peer (the learner itself, same-host workers) shift by zero.
+  :mod:`apex_tpu.fleet.heartbeat` (each beat samples
+  learner-wall-at-receive - peer-wall-at-send = skew + transit;
+  ``clock_offset_s`` is the min-transit median over the recent sample
+  window — transit only ever ADDS, so the smallest samples are the
+  closest to pure skew, and the median over that low half rides out
+  one anomalous beat) and persists it in ``fleet_summary.json``
+  together with ``clock_offset_n`` (samples behind the estimate); when
+  a summary is given (or found next to the traces), each file whose
+  label matches a peer identity is shifted onto the learner's
+  timeline.  Files without a matching peer (the learner itself,
+  same-host workers) shift by zero.
 * **Pid remapping.**  Every file becomes one perfetto process group
   (sequential pids, ``process_name`` = the role label), so two roles
   that happened to share an OS pid across hosts cannot collide.
@@ -35,13 +40,26 @@ import os
 
 def load_offsets(summary: dict) -> dict[str, float]:
     """identity -> clock_offset_s from a ``fleet_summary.json`` snapshot
-    (peers without a measured offset map to 0)."""
+    (peers without a measured offset map to 0).  The registry's offset is
+    already the min-transit median over its sample window (module
+    docstring); single-sample peers (``clock_offset_n`` <= 1) still align
+    — their estimate just carries that one beat's transit."""
     out: dict[str, float] = {}
     for peer in summary.get("peers", []):
         off = peer.get("clock_offset_s")
         if off is not None:
             out[peer["identity"]] = float(off)
     return out
+
+
+def offset_quality(summary: dict) -> dict[str, int]:
+    """identity -> sample count behind each offset estimate — surfaced in
+    the merged trace metadata so a timeline with suspicious alignment can
+    be triaged without re-running the fleet (n=1 means one transit of
+    noise; n near the window size means the estimator had data)."""
+    return {peer["identity"]: int(peer.get("clock_offset_n", 0))
+            for peer in summary.get("peers", [])
+            if peer.get("clock_offset_s") is not None}
 
 
 def merge_traces(traces: list[dict],
@@ -105,13 +123,20 @@ def merge_dir(trace_dir: str, out_path: str,
         except (OSError, json.JSONDecodeError) as e:
             print(f"obs.merge: skipping {p}: {e}")
     offsets: dict[str, float] = {}
+    quality: dict[str, int] = {}
     if fleet_summary is None:
         candidate = os.path.join(trace_dir, "fleet_summary.json")
         fleet_summary = candidate if os.path.exists(candidate) else None
     if fleet_summary:
         with open(fleet_summary, "r", encoding="utf-8") as fh:
-            offsets = load_offsets(json.load(fh))
+            summary = json.load(fh)
+        offsets = load_offsets(summary)
+        quality = offset_quality(summary)
     merged = merge_traces(traces, offsets)
+    if quality:
+        merged["metadata"]["offset_samples"] = {
+            k: v for k, v in quality.items()
+            if k in merged["metadata"]["merged_from"]}
     tmp = out_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(merged, fh)

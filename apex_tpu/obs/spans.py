@@ -53,8 +53,15 @@ from apex_tpu.utils.metrics import percentile
 #: chunk-message metadata key (a LIST of span dicts)
 SPAN_KEY = "obs_spans"
 
-#: canonical hop order (lineage trace events pair consecutive present hops)
-HOPS = ("sealed", "send", "recv", "merge", "stage", "consume", "prio_wb")
+#: canonical hop order (lineage trace events pair consecutive present
+#: hops).  The three shard_* hops exist only on the sharded replay
+#: service path (apex_tpu/replay_service): chunk decoded on the shard
+#: socket -> folded into a pre-sampled batch -> batch handed to the
+#: learner's pull — so frame-age-at-train stays measurable across the
+#: extra network hop (a batch carries the spans of the freshest source
+#: chunks folded into it since the previous sample).
+HOPS = ("sealed", "send", "shard_recv", "shard_sample", "batch_send",
+        "recv", "merge", "stage", "consume", "prio_wb")
 
 
 def enabled() -> bool:

@@ -1,0 +1,34 @@
+"""Sharded replay service: prioritized replay as its own fleet role.
+
+The Ape-X reference ran replay as a standalone process between actors
+and the learner (``origin_repo/replay.py``); the TPU port initially
+dissolved it into the learner's HBM, making the learner host the single
+ingest/sampling bottleneck.  This package restores the standalone role,
+sharded N ways:
+
+* :mod:`~apex_tpu.replay_service.shard`   — one shard's deterministic
+  compute (ingest → PER sample → priority write-back over a
+  ``FramePoolReplay`` segment tree; N=1 strict mode is bit-identical to
+  in-learner replay).
+* :mod:`~apex_tpu.replay_service.service` — the ``--role replay`` socket
+  process (ROUTER, restricted unpickler, heartbeats, chaos gate).
+* :mod:`~apex_tpu.replay_service.sender`  — actor-side chunk→shard hash
+  routing with per-shard credit windows and learner-direct fallback.
+* :mod:`~apex_tpu.replay_service.client`  — learner-side round-robin
+  batch puller + write-back router (driven by the ingest pipeline's
+  staging thread).
+"""
+
+from apex_tpu.replay_service.client import ReplayServiceClient
+from apex_tpu.replay_service.sender import ShardedChunkSender, chunk_shard
+from apex_tpu.replay_service.shard import ReplayShardCore
+from apex_tpu.replay_service.service import (ReplayShardServer,
+                                             build_shard_core,
+                                             run_replay_shard,
+                                             shard_warmup)
+
+__all__ = [
+    "ReplayServiceClient", "ReplayShardCore", "ReplayShardServer",
+    "ShardedChunkSender", "build_shard_core", "chunk_shard",
+    "run_replay_shard", "shard_warmup",
+]

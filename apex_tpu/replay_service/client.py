@@ -1,0 +1,169 @@
+"""Learner-side client of the sharded replay service.
+
+One DEALER per shard, all driven by EXACTLY ONE thread — the ingest
+pipeline's staging thread when the pipeline is on (the staging thread
+already owns every ``poll_chunks``/``publish_params`` call, see
+:class:`~apex_tpu.runtime.transport.RemotePool`'s thread-affinity
+contract), else the trainer thread.  Construction happens on the caller
+thread and the sockets migrate once: the migrate-then-use-single-threaded
+pattern zmq tolerates.
+
+Protocol per shard (DEALER <-> the shard's ROUTER,
+:mod:`apex_tpu.replay_service.service`):
+
+* ``("pull",)``                 -> ``("batch", msg)`` | ``("dry", info)``
+* ``("prio", seq, idx, prios)`` -> (no reply — the write-back is the ack)
+
+At most one pull is outstanding per shard (re-sent after ``retry_s`` so a
+shard that died mid-request is probed, not trusted); replies are decoded
+through the restricted wire unpickler, so a compromised shard costs
+counted drops, never execution.  Round-robin starts at a rotating cursor
+— no shard starves behind a chatty one — and a shard that stops
+answering simply stops contributing batches: the learner keeps training
+on whatever the surviving shards serve (the registry's DEAD transition,
+fed by the shard's own heartbeats, is the operator-facing signal).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from apex_tpu.config import CommsConfig
+from apex_tpu.obs import spans as obs_spans
+from apex_tpu.runtime import wire
+
+
+class ReplayServiceClient:
+    """Round-robin batch puller + priority write-back router."""
+
+    def __init__(self, comms: CommsConfig, n_shards: int | None = None,
+                 replay_ip: str | None = None, identity: str = "learner",
+                 retry_s: float = 2.0):
+        import zmq
+
+        self._zmq = zmq
+        self.comms = comms
+        self.n_shards = n_shards or comms.replay_shards
+        if self.n_shards <= 0:
+            raise ValueError("ReplayServiceClient needs replay_shards > 0")
+        ip = replay_ip or comms.replay_ip
+        ctx = zmq.Context.instance()
+        self.socks = []
+        for s in range(self.n_shards):
+            sock = ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.IDENTITY,
+                            f"{identity}-{s}".encode())
+            # bounded send queue: pulls/prios to a dead shard must pile
+            # up in the counter below, not in an unbounded kernel buffer
+            sock.setsockopt(zmq.SNDHWM, 64)
+            sock.connect(f"tcp://{ip}:{comms.replay_port_base + s}")
+            self.socks.append(sock)
+        self.retry_s = float(retry_s)
+        self._rr = 0
+        self._outstanding = [False] * self.n_shards
+        self._last_pull = [0.0] * self.n_shards
+        self._ingested = [0] * self.n_shards
+        self.batches = 0
+        self.rejected = 0           # replies outside the wire allowlist
+        self.prio_sent = 0
+        self.prio_dropped = 0       # write-backs a full send queue refused
+        self.unanswered = [0] * self.n_shards   # consecutive pull retries
+
+    # -- pulls ---------------------------------------------------------------
+
+    def _ensure_pull(self, s: int, now: float) -> None:
+        if self._outstanding[s] and now - self._last_pull[s] < self.retry_s:
+            return
+        if self._outstanding[s]:
+            self.unanswered[s] += 1     # retry: the last pull went silent
+        try:
+            self.socks[s].send(wire.dumps(("pull",)), self._zmq.DONTWAIT)
+            self._outstanding[s] = True
+            self._last_pull[s] = now
+        except self._zmq.Again:
+            pass
+
+    def _recv(self, s: int):
+        """Drain one reply off shard ``s``; a batch message or None."""
+        while self.socks[s].poll(0, self._zmq.POLLIN):
+            try:
+                msg = wire.restricted_loads(self.socks[s].recv())
+            except wire.WireRejected:
+                self.rejected += 1
+                self._outstanding[s] = False
+                continue
+            self._outstanding[s] = False
+            self.unanswered[s] = 0
+            kind = msg[0]
+            if kind == "batch":
+                body = msg[1]
+                body["shard"] = s
+                self._ingested[s] = max(self._ingested[s],
+                                        int(body.get("ingested", 0)))
+                obs_spans.stamp(body, "recv")
+                self.batches += 1
+                return body
+            if kind == "dry":
+                info = msg[1]
+                self._ingested[s] = max(self._ingested[s],
+                                        int(info.get("ingested", 0)))
+        return None
+
+    def poll_batch(self, timeout: float = 0.0) -> dict | None:
+        """Next pre-sampled batch, round-robin over shards; None when no
+        shard served one within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while True:
+            now = time.monotonic()
+            for off in range(self.n_shards):
+                s = (self._rr + off) % self.n_shards
+                self._ensure_pull(s, now)
+                got = self._recv(s)
+                if got is not None:
+                    self._rr = (s + 1) % self.n_shards
+                    return got
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            # one poller pass over every shard socket instead of a sleep:
+            # the first reply wakes us
+            poller = self._zmq.Poller()
+            for sock in self.socks:
+                poller.register(sock, self._zmq.POLLIN)
+            poller.poll(min(50.0, remaining * 1000.0))
+
+    # -- write-backs ---------------------------------------------------------
+
+    def push_priorities(self, shard: int, seq: int, idx,
+                        priorities) -> bool:
+        """Ship one batch's TD priorities to its owning shard.  Non-
+        blocking: a dead shard's write-backs are counted and dropped (it
+        forgives them server-side), never wedge the learner."""
+        payload = wire.dumps(("prio", int(seq),
+                              np.asarray(idx),
+                              np.asarray(priorities, np.float32)))
+        try:
+            self.socks[int(shard)].send(payload, self._zmq.DONTWAIT)
+            self.prio_sent += 1
+            return True
+        except self._zmq.Again:
+            self.prio_dropped += 1
+            return False
+
+    # -- observability -------------------------------------------------------
+
+    def ingested_total(self) -> int:
+        """Sum of the shards' last-reported resident transition counts —
+        the service-mode input to the trainer's warmup/ratio math."""
+        return sum(self._ingested)
+
+    def shard_status(self) -> list[dict]:
+        return [{"shard": s, "ingested": self._ingested[s],
+                 "unanswered": self.unanswered[s]}
+                for s in range(self.n_shards)]
+
+    def close(self) -> None:
+        for sock in self.socks:
+            sock.close(linger=0)

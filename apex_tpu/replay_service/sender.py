@@ -1,0 +1,120 @@
+"""Actor-side chunk routing for the sharded replay service.
+
+The reference's actors open one push socket to THE replay host
+(``origin_repo/actor.py:105-115``); here the replay plane is N shard
+processes, so the actor opens one credit-windowed
+:class:`~apex_tpu.runtime.transport.ChunkSender` per shard and routes each
+sealed chunk by a STABLE hash of its chunk id (``identity:seq``) —
+deterministic, uniform, and independent of arrival timing, so a chunk's
+owning shard can be recomputed anywhere (tests pin the mapping).
+
+Fallback semantics: a shard whose credit window stays exhausted past
+``shard_wait_s`` (dead shard, or one wedged behind a dead learner's
+write-backs) does not strand the chunk — it reroutes to the LEARNER's
+direct ingest socket, which still runs the pre-service fused path.  The
+learner channel is also where park/rejoin liveness is probed
+(``fleet/park.py``), so a dead learner parks the actor exactly as before.
+
+Stats/heartbeats always ride the learner channel — membership lives in
+the learner's :class:`~apex_tpu.fleet.registry.FleetRegistry`.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from apex_tpu.config import CommsConfig
+from apex_tpu.runtime import transport
+
+
+def chunk_shard(chunk_id: str, n_shards: int) -> int:
+    """Stable chunk-id -> shard index (crc32: identical across processes,
+    platforms, and runs — the routing IS the sharding function)."""
+    return zlib.crc32(chunk_id.encode()) % max(1, n_shards)
+
+
+class ShardedChunkSender:
+    """N per-shard credit-windowed senders + the learner direct channel.
+
+    Presents the single-sender interface the queue adapters, the park
+    controller, and the chaos wrapper already speak (``send_chunk`` /
+    ``send_stat`` / ``reset_credits`` / wire counters / ``close``), so
+    the whole actor stack switches transports with one constructor.
+    """
+
+    def __init__(self, comms: CommsConfig, identity: str,
+                 direct: transport.ChunkSender | None = None,
+                 n_shards: int | None = None, replay_ip: str | None = None,
+                 shard_wait_s: float = 2.0):
+        self.comms = comms
+        self.identity = identity
+        self.n_shards = n_shards or comms.replay_shards
+        if self.n_shards <= 0:
+            raise ValueError("ShardedChunkSender needs replay_shards > 0 "
+                             "(use a plain ChunkSender for the in-learner "
+                             "topology)")
+        ip = replay_ip or comms.replay_ip
+        self.shards = [
+            transport.ChunkSender(comms, identity, ip=ip,
+                                  port=comms.replay_port_base + s)
+            for s in range(self.n_shards)]
+        # the learner channel: stats/heartbeats, park liveness, and the
+        # chunk fallback path — built here unless the caller already owns
+        # one (run_actor constructs it first so ParkController sees it)
+        self.direct = direct or transport.ChunkSender(comms, identity)
+        self.shard_wait_s = float(shard_wait_s)
+        self._seq = 0
+        self.rerouted = 0           # chunks that fell back to the learner
+
+    # -- data plane ----------------------------------------------------------
+
+    def send_chunk(self, msg: dict, stop_event=None,
+                   max_wait_s: float | None = None) -> bool:
+        """Hash-route one chunk to its shard; on a wedged shard window,
+        reroute to the learner's direct ingest.  The final wait semantics
+        (None = block, ``max_wait_s`` = bounded) apply to the fallback
+        channel, so park-controller wedge detection keys off LEARNER
+        liveness exactly as in the unsharded topology."""
+        cid = msg.get("chunk_id")
+        if cid is None:
+            cid = msg["chunk_id"] = f"{self.identity}:{self._seq}"
+        self._seq += 1
+        s = chunk_shard(cid, self.n_shards)
+        wait = self.shard_wait_s
+        if max_wait_s is not None:
+            wait = min(wait, max_wait_s)
+        if self.shards[s].send_chunk(msg, stop_event, max_wait_s=wait):
+            return True
+        if stop_event is not None and stop_event.is_set():
+            return False
+        self.rerouted += 1
+        return self.direct.send_chunk(msg, stop_event,
+                                      max_wait_s=max_wait_s)
+
+    def send_stat(self, stat) -> None:
+        self.direct.send_stat(stat)
+
+    # -- park/heartbeat hooks ------------------------------------------------
+
+    def reset_credits(self) -> None:
+        """Rejoin after a learner death: every outstanding ack died with
+        it — including shard acks wedged behind the dead learner's
+        write-back gate (strict ordering)."""
+        self.direct.reset_credits()
+        for s in self.shards:
+            s.reset_credits()
+
+    @property
+    def chunks_sent(self) -> int:
+        return (self.direct.chunks_sent
+                + sum(s.chunks_sent for s in self.shards))
+
+    @property
+    def acks_received(self) -> int:
+        return (self.direct.acks_received
+                + sum(s.acks_received for s in self.shards))
+
+    def close(self, drain_s: float = 2.0) -> None:
+        for s in self.shards:
+            s.close(drain_s=drain_s)
+        self.direct.close(drain_s=drain_s)

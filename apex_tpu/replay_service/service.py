@@ -1,0 +1,287 @@
+"""The replay shard role: sockets, heartbeats, chaos, lifecycle.
+
+The reference ran replay as a standalone process bridging actors and the
+learner with three zmq proxies (``origin_repo/replay.py:48-74``).  This
+role restores that topology for the TPU port, sharded: shard ``s`` binds
+ONE ROUTER at ``replay_port_base + s`` and multiplexes the three message
+kinds on it —
+
+* ``("chunk", msg)``   from actors: restricted-decode, chaos gate,
+  ingest into the shard's :class:`~apex_tpu.replay_service.shard.
+  ReplayShardCore`, then ack (the ack IS the sender's next credit, same
+  protocol as the learner's :class:`~apex_tpu.runtime.transport.
+  ChunkReceiver`; a hostile payload is counted and dropped WITHOUT an
+  ack, wedging only its sender's window);
+* ``("pull",)``        from the learner: reply the next pre-sampled
+  batch, or ``("dry", {...})`` so the learner's round-robin moves on;
+* ``("prio", seq, idx, prios)`` from the learner: apply the write-back.
+
+Strict-order deferral: while a write-back is outstanding the core
+refuses ingest (:meth:`ReplayShardCore.can_ingest` — ingest and
+write-back do not commute bitwise once the ring wraps), so arriving
+chunks park in a host-side inbox WITHOUT acks — the actor credit windows
+backpressure exactly like the learner's bounded queue does.  A learner
+that dies mid-round-trip would wedge that gate forever, so write-back
+silence past ``dead_after_s`` forgives the outstanding batches (counted).
+
+Membership: the shard ships ordinary :class:`~apex_tpu.fleet.heartbeat.
+Heartbeat`\\ s (role ``"replay"``) on a plain stat channel to the
+learner's ROUTER — zero new control sockets, and the learner's
+:class:`~apex_tpu.fleet.registry.FleetRegistry` runs its
+JOINING→ALIVE→SUSPECT→DEAD machine over shards for free (a chaos-killed
+shard shows up DEAD in ``fleet_summary.json``, pinned in tests).
+
+Chaos: ``CHAOS_SEED``/``CHAOS_SPEC`` gate a per-shard plan under the
+identity ``replay-<shard_id>`` — ``kill`` fires on the chunk-ingest
+index (``os._exit(137)``), ``drop_frac`` drops ingested chunks (acked,
+so the loss is silent data loss, exactly what a dying shard produces).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig, CommsConfig
+from apex_tpu.obs import spans as obs_spans
+from apex_tpu.replay_service.shard import ReplayShardCore
+from apex_tpu.runtime import wire
+
+
+def shard_warmup(global_warmup: int, n_shards: int) -> int:
+    """Per-shard warmup: the global gate split over shards (ceil — the
+    fleet never trains EARLIER than the unsharded config would)."""
+    return max(1, -(-int(global_warmup) // max(1, n_shards)))
+
+
+def dqn_replay_spec(cfg: ApexConfig):
+    """The FramePoolReplay spec the DQN learner builds — factored out so
+    the shard role and :class:`~apex_tpu.training.apex.ApexTrainer`
+    cannot drift (one spec, two owners would eventually disagree on
+    frame shapes)."""
+    from apex_tpu.replay.base import check_hbm_budget
+    from apex_tpu.replay.frame_pool import FramePoolReplay
+    from apex_tpu.training.apex import dqn_env_specs
+
+    _, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    replay = FramePoolReplay(
+        capacity=cfg.replay.capacity, frame_shape=frame_shape,
+        frame_stack=frame_stack, frame_dtype=np.dtype(frame_dtype).name,
+        alpha=cfg.replay.alpha, eps=cfg.replay.eps)
+    check_hbm_budget(replay.hbm_bytes(), cfg.replay.hbm_budget_gb,
+                     "replay-shard frame pool", cfg.replay.capacity)
+    return replay
+
+
+def build_shard_core(cfg: ApexConfig, shard_id: int,
+                     family: str = "dqn") -> ReplayShardCore:
+    """One shard's core from the fleet config.  ``capacity``/``warmup``
+    are per shard (capacity as configured — N shards hold N x capacity;
+    warmup split so the global gate is preserved)."""
+    import jax
+
+    if family != "dqn":
+        raise NotImplementedError(
+            f"replay service shards currently serve the dqn family only "
+            f"(got {family!r}); aql/r2d2 stay on in-learner replay — see "
+            f"ROADMAP.md")
+    replay = dqn_replay_spec(cfg)
+    n = max(1, cfg.comms.replay_shards)
+    key = jax.random.key(cfg.env.seed + 977_000 + shard_id)
+    return ReplayShardCore(
+        replay, key,
+        batch_size=cfg.learner.batch_size,
+        warmup=shard_warmup(cfg.replay.warmup, n),
+        beta=cfg.replay.beta, beta_anneal=cfg.replay.beta_anneal,
+        n_shards=n,
+        strict_order=cfg.comms.replay_strict_order,
+        presample_depth=cfg.comms.replay_presample)
+
+
+class _ShardChaos:
+    """The replay-shard fault gate: one RNG draw per ingested chunk off
+    the seeded per-identity stream (:mod:`apex_tpu.fleet.chaos`), so a
+    shard's kills and drops replay exactly, run after run."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self._rng = plan.rng() if plan is not None else None
+        self._n = 0
+        self.dropped = 0
+
+    def on_chunk(self) -> str:
+        """"ok" | "drop"; a scheduled kill never returns."""
+        if self.plan is None:
+            return "ok"
+        i = self._n
+        self._n += 1
+        if self.plan.kill_at is not None and i >= self.plan.kill_at:
+            from apex_tpu.fleet.chaos import _die
+            _die(self.plan.identity, i)
+        if self._rng.random() < self.plan.drop_frac:
+            self.dropped += 1
+            return "drop"
+        return "ok"
+
+
+class ReplayShardServer:
+    """Socket loop around one :class:`ReplayShardCore` (module
+    docstring).  Single-threaded on purpose: one thread owns the ROUTER,
+    the jit dispatches, and the deterministic op order the strict mode
+    promises."""
+
+    def __init__(self, comms: CommsConfig, shard_id: int,
+                 core: ReplayShardCore, bind_ip: str = "*",
+                 heartbeat=True):
+        import zmq
+
+        from apex_tpu.fleet.chaos import chaos_from_env
+
+        self._zmq = zmq
+        self.comms = comms
+        self.shard_id = int(shard_id)
+        self.core = core
+        self.identity = f"replay-{shard_id}"
+        self.sock = zmq.Context.instance().socket(zmq.ROUTER)
+        self.sock.bind(f"tcp://{bind_ip}:{comms.replay_port_base + shard_id}")
+        self.rejected = 0
+        self.batches_served = 0
+        self._inbox: list = []          # strict-mode deferred (ident, msg)
+        self._last_wb = time.monotonic()
+        chaos = chaos_from_env()
+        self.chaos = _ShardChaos(chaos.plan_for(self.identity)
+                                 if chaos is not None else None)
+        self._hb = None
+        self._hb_sender = None
+        if heartbeat:
+            from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+            from apex_tpu.runtime.transport import ChunkSender
+            self._hb_sender = ChunkSender(comms, self.identity)
+            self._hb = HeartbeatEmitter(
+                self.identity, role="replay",
+                interval_s=comms.heartbeat_interval_s,
+                counters_fn=lambda: {
+                    "chunks_sent": self.batches_served,
+                    "acks_received": self.core.wb_applied})
+
+    # -- message handlers ----------------------------------------------------
+
+    def _handle_chunk(self, ident: bytes, msg: dict) -> None:
+        if self.chaos.on_chunk() == "drop":
+            self.sock.send_multipart([ident, b"ack"])   # silent data loss
+            return
+        obs_spans.stamp(msg, "shard_recv")
+        if not self.core.can_ingest():
+            self._inbox.append((ident, msg))            # ack withheld:
+            return                                      # credit paces sender
+        self.core.ingest_msg(msg)
+        if self._hb is not None:
+            self._hb.tick(int(msg.get("n_trans", 0)))
+        self.sock.send_multipart([ident, b"ack"])
+
+    def _drain_inbox(self) -> None:
+        while self._inbox and self.core.can_ingest():
+            ident, msg = self._inbox.pop(0)
+            self.core.ingest_msg(msg)
+            if self._hb is not None:
+                self._hb.tick(int(msg.get("n_trans", 0)))
+            self.sock.send_multipart([ident, b"ack"])
+
+    def _handle_pull(self, ident: bytes) -> None:
+        batch = self.core.next_batch()
+        if batch is None:
+            reply = ("dry", {"ingested": self.core.ingested,
+                             "warm": self.core.warm})
+        else:
+            obs_spans.stamp(batch, "batch_send")
+            self.batches_served += 1
+            reply = ("batch", batch)
+        self.sock.send_multipart([ident, wire.dumps(reply)])
+
+    def _handle_prio(self, seq: int, idx, prios) -> None:
+        self.core.write_back(int(seq), idx, prios)
+        self._last_wb = time.monotonic()
+        self._drain_inbox()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def step(self, timeout_ms: int = 100) -> bool:
+        """One poll/dispatch round; True when a message was handled."""
+        if self._hb is not None:
+            hb = self._hb.maybe_beat(0)
+            if hb is not None:
+                self._hb_sender.send_stat(hb)
+        if (self.core.outstanding() > 0
+                and time.monotonic() - self._last_wb
+                > self.comms.dead_after_s):
+            # the learner died between pull and write-back: forgive so
+            # the strict gate (and the actor fleet behind it) unwedges
+            n = self.core.forgive_outstanding()
+            self._last_wb = time.monotonic()
+            print(f"{self.identity}: forgave {n} outstanding "
+                  f"write-back(s) after {self.comms.dead_after_s:.0f}s "
+                  f"of learner silence", flush=True)
+            self._drain_inbox()
+        if not self.sock.poll(timeout_ms, self._zmq.POLLIN):
+            return False
+        ident, payload = self.sock.recv_multipart()
+        try:
+            msg = wire.restricted_loads(payload)
+        except wire.WireRejected:
+            self.rejected += 1      # counted, dropped, and NOT acked
+            return True
+        kind = msg[0] if isinstance(msg, tuple) and msg else None
+        if kind == "chunk":
+            self._handle_chunk(ident, msg[1])
+        elif kind == "pull":
+            self._handle_pull(ident)
+        elif kind == "prio":
+            self._handle_prio(msg[1], msg[2], msg[3])
+        else:
+            self.rejected += 1      # well-pickled garbage is still garbage
+        return True
+
+    def run(self, stop_event=None, max_seconds: float | None = None) -> dict:
+        deadline = (None if max_seconds is None
+                    else time.monotonic() + max_seconds)
+        while True:
+            if stop_event is not None and stop_event.is_set():
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            self.step()
+        return self.stats()
+
+    def stats(self) -> dict:
+        return {**self.core.stats(), "shard": self.shard_id,
+                "batches_served": self.batches_served,
+                "rejected": self.rejected,
+                "chaos_dropped": self.chaos.dropped,
+                "inbox_deferred": len(self._inbox)}
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+        if self._hb_sender is not None:
+            self._hb_sender.close(drain_s=0.0)
+
+
+def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
+                     stop_event=None, max_seconds: float | None = None,
+                     bind_ip: str = "*") -> dict:
+    """The ``--role replay`` entry point: build the shard core from the
+    fleet config, serve until stopped.  Returns the final stats dict."""
+    from apex_tpu.obs.trace import get_ring, set_process_label
+
+    set_process_label(f"replay-{shard_id}")
+    get_ring()                      # arm the trace ring's dump triggers
+    core = build_shard_core(cfg, shard_id, family=family)
+    server = ReplayShardServer(cfg.comms, shard_id, core)
+    print(f"replay-{shard_id}: serving on port "
+          f"{cfg.comms.replay_port_base + shard_id} "
+          f"(capacity={cfg.replay.capacity}, warmup={core.warmup}/shard, "
+          f"strict={core.strict_order})", flush=True)
+    try:
+        return server.run(stop_event=stop_event, max_seconds=max_seconds)
+    finally:
+        server.close()

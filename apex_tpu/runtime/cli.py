@@ -45,9 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="apex_tpu",
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
-                   choices=["learner", "actor", "evaluator", "status",
-                            "dqn", "aql", "r2d2", "apex", "enjoy"],
-                   help="socket roles: learner/actor/evaluator; "
+                   choices=["learner", "actor", "evaluator", "replay",
+                            "status", "dqn", "aql", "r2d2", "apex",
+                            "enjoy"],
+                   help="socket roles: learner/actor/evaluator/replay "
+                        "(one prioritized-replay shard — see "
+                        "--replay-shards/--shard-id); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
                         "single-host drivers: dqn/aql/r2d2/apex; "
@@ -85,6 +88,34 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(e.get("APEX_BARRIER_PORT", c.barrier_port)))
     p.add_argument("--status-port", type=int,
                    default=int(e.get("APEX_STATUS_PORT", c.status_port)))
+    # sharded replay service (apex_tpu/replay_service): the whole fleet
+    # must agree on these, so they ride the shared COMMON flag set / env
+    # twins like the ports above
+    p.add_argument("--replay-shards", type=int,
+                   default=int(e.get("APEX_REPLAY_SHARDS",
+                                     c.replay_shards)),
+                   help="N > 0: run prioritized replay as N standalone "
+                        "shard processes (--role replay); actors hash "
+                        "chunks to shards, the learner pulls pre-sampled "
+                        "batches.  0 (default) = in-learner replay")
+    p.add_argument("--replay-port-base", type=int,
+                   default=int(e.get("APEX_REPLAY_PORT_BASE",
+                                     c.replay_port_base)),
+                   help="shard s binds replay_port_base + s")
+    p.add_argument("--replay-ip", default=ident.replay_ip,
+                   help="host the replay shards run on (env twin "
+                        "REPLAY_IP); defaults to localhost")
+    p.add_argument("--shard-id", type=int,
+                   default=int(e.get("SHARD_ID", 0)),
+                   help="replay role: this process's shard index in "
+                        "[0, replay_shards)")
+    p.add_argument("--replay-loose", action="store_true",
+                   default=_env_bool(e.get("APEX_REPLAY_LOOSE", "")),
+                   help="loose shard ordering (reference semantics: "
+                        "pre-sample ahead, apply write-backs whenever "
+                        "they land) instead of the default strict "
+                        "lockstep that is bit-identical to in-learner "
+                        "replay at N=1")
     # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
     # and the registry/park state-machine windows — env twins so a whole
     # topology (tests, chaos drills) retunes them without flag plumbing
@@ -204,13 +235,18 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                           heartbeat_interval_s=args.heartbeat_interval,
                           suspect_after_s=args.suspect_after,
                           dead_after_s=args.dead_after,
-                          park_after_s=args.park_after),
+                          park_after_s=args.park_after,
+                          replay_shards=args.replay_shards,
+                          replay_port_base=args.replay_port_base,
+                          replay_ip=args.replay_ip,
+                          replay_strict_order=not args.replay_loose),
     )
 
 
 def identity_from_args(args: argparse.Namespace) -> RoleIdentity:
     return RoleIdentity(role=args.role, actor_id=args.actor_id,
-                        n_actors=args.n_actors, learner_ip=args.learner_ip)
+                        n_actors=args.n_actors, learner_ip=args.learner_ip,
+                        replay_ip=args.replay_ip)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -260,6 +296,22 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       episodes=args.episodes, logdir=args.logdir,
                       verbose=args.verbose,
                       barrier_timeout_s=args.barrier_timeout)
+    elif args.role == "replay":
+        # one prioritized-replay shard (apex_tpu/replay_service): binds
+        # replay_port_base + shard_id, serves until killed/--max-seconds.
+        # Shards skip the startup barrier — the learner counts only
+        # actors/evaluators there, and a shard is useful the moment its
+        # ROUTER binds.
+        if not 0 <= args.shard_id < max(1, cfg.comms.replay_shards):
+            raise SystemExit(
+                f"--shard-id {args.shard_id} outside [0, "
+                f"{cfg.comms.replay_shards}) — set --replay-shards/"
+                f"APEX_REPLAY_SHARDS fleet-wide")
+        from apex_tpu.replay_service.service import run_replay_shard
+        from apex_tpu.runtime.roles import _with_ips
+        cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
+        run_replay_shard(cfg, args.shard_id, family=args.family,
+                         max_seconds=args.max_seconds)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the

@@ -1,9 +1,12 @@
 """Process roles for the multi-host topology (reference L6 role scripts).
 
 The reference runs four role scripts — ``origin_repo/{learner,actor,replay,
-eval}.py`` — wired by env vars (``actor.py:18-25``).  Here the replay role is
-dissolved into the learner (HBM-resident replay, see
-:mod:`apex_tpu.runtime.transport`), leaving three:
+eval}.py`` — wired by env vars (``actor.py:18-25``).  By default the replay
+role is dissolved into the learner (HBM-resident replay, see
+:mod:`apex_tpu.runtime.transport`); ``comms.replay_shards > 0`` restores it
+as a sharded standalone plane (:mod:`apex_tpu.replay_service` — its role
+entry point lives there as ``run_replay_shard``, dispatched by the CLI).
+The three roles here:
 
 * :func:`run_learner` — the standard :class:`ApexTrainer` driving a
   socket-backed :class:`RemotePool`: identical fused learner, chunks arrive
@@ -145,6 +148,19 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
     """
     pool = transport.RemotePool(cfg.comms, n_peers, queue_depth=queue_depth,
                                 barrier_timeout_s=barrier_timeout_s)
+    client = None
+    if cfg.comms.replay_shards > 0:
+        # sharded replay service: sampling lives in the shard fleet; the
+        # learner pulls pre-sampled batches and ships write-backs.  The
+        # chunk ROUTER above stays bound — it still carries stats,
+        # heartbeats, and the actors' direct-ingest fallback chunks.
+        if family != "dqn":
+            pool.cleanup()
+            raise NotImplementedError(
+                f"--replay-shards currently serves the dqn family only "
+                f"(got {family!r}) — aql/r2d2 stay on in-learner replay")
+        from apex_tpu.replay_service.client import ReplayServiceClient
+        client = ReplayServiceClient(cfg.comms)
     try:
         if family == "dqn":
             from apex_tpu.training.apex import ApexTrainer
@@ -171,12 +187,20 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
             raise ValueError(f"unknown family {family!r}")
         if restore:
             trainer.restore()        # newest checkpoint in checkpoint_dir
+        trainer.replay_client = client
     except BaseException:
         # the pool binds its ROUTER at construction — unwind it if the
         # trainer never gets far enough for train()'s finally to run
         pool.cleanup()
+        if client is not None:
+            client.close()
         raise
-    return trainer.train(total_steps=total_steps, max_seconds=max_seconds)
+    try:
+        return trainer.train(total_steps=total_steps,
+                             max_seconds=max_seconds)
+    finally:
+        if client is not None:
+            client.close()
 
 
 def _join_fleet(comms, name: str, stop_event,
@@ -217,7 +241,14 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     eps = actor_epsilons(identity.n_actors, cfg.actor.eps_base,
                          cfg.actor.eps_alpha)[identity.actor_id]
 
-    sender = maybe_wrap_sender(transport.ChunkSender(comms, name), name)
+    sender = transport.ChunkSender(comms, name)
+    if comms.replay_shards > 0:
+        # sharded replay service: chunks hash to shard sockets; the
+        # learner channel just built stays the stat/heartbeat pipe, the
+        # park-liveness probe, and the direct-ingest fallback
+        from apex_tpu.replay_service.sender import ShardedChunkSender
+        sender = ShardedChunkSender(comms, name, direct=sender)
+    sender = maybe_wrap_sender(sender, name)
     park = ParkController(comms, name, stop_event, sub=sub, sender=sender)
     chunk_arg = cfg.actor.send_interval
     if family == "dqn":
@@ -315,11 +346,21 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
 
 def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
                     sub, sender, log, env, park=None) -> list[float]:
+    import time
+
     import jax
     import jax.numpy as jnp
 
     from apex_tpu.actors.pool import EpisodeStat
     from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+    from apex_tpu.obs.trace import get_ring, set_process_label
+
+    # evaluators were the one role without a trace ring: label the
+    # process by its fleet identity (obs.merge joins it against the
+    # registry's clock offsets) and record episode/param-refresh events
+    set_process_label(park.identity if park is not None
+                      else f"evaluator-{identity.actor_id}")
+    ring = get_ring()
 
     reset_act = None            # recurrent families override per episode
     if family == "dqn":
@@ -384,6 +425,7 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
         if reset_act is not None:       # recurrent: fresh carry per episode
             reset_act()
         total, done, steps = 0.0, False, 0
+        ep_t0 = time.perf_counter()
         while not done and steps < max_steps and not stop_event.is_set():
             key, k = jax.random.split(key)
             obs, r, term, trunc, _ = env.step(act(params, np.asarray(obs), k))
@@ -395,6 +437,10 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             if hb is not None:
                 sender.send_stat(hb)
         scores.append(total)
+        ring.complete("episode", ep_t0, time.perf_counter() - ep_t0,
+                      track="eval-episodes",
+                      args={"reward": round(total, 3), "steps": steps,
+                            "param_version": version})
         log.scalars({"episode_reward": total, "episode_length": steps,
                      "param_version": version}, ep)
         sender.send_stat(EpisodeStat(-(identity.actor_id + 1), total, steps,
@@ -404,6 +450,8 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             version, params = got
             if park is not None:
                 park.note_params()
+            ring.instant("param_refresh", track="eval-episodes",
+                         args={"version": version})
         elif park is not None and park.stale():
             # the stream died mid-run: park between episodes, resume on
             # the respawned learner's first publish
@@ -416,9 +464,14 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
 
 
 def _with_ips(comms: CommsConfig, identity: RoleIdentity) -> CommsConfig:
-    """An EXPLICIT learner IP on the role identity wins over the config
-    (``actor.py:18-25`` env-var pattern); a default-constructed identity
-    must not stomp a configured ``comms.learner_ip`` with localhost."""
-    if identity.learner_ip != RoleIdentity().learner_ip:
-        return dataclasses.replace(comms, learner_ip=identity.learner_ip)
-    return comms
+    """An EXPLICIT learner/replay IP on the role identity wins over the
+    config (``actor.py:18-25`` env-var pattern); a default-constructed
+    identity must not stomp a configured ``comms.learner_ip`` (or
+    ``replay_ip``) with localhost."""
+    default = RoleIdentity()
+    overrides = {}
+    if identity.learner_ip != default.learner_ip:
+        overrides["learner_ip"] = identity.learner_ip
+    if identity.replay_ip != default.replay_ip:
+        overrides["replay_ip"] = identity.replay_ip
+    return dataclasses.replace(comms, **overrides) if overrides else comms

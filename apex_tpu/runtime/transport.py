@@ -8,11 +8,16 @@ transition and priority streams (``actor.py:105-115``,
 (``learner.py:30-54``, ``actor.py:28-37``), and three ``zmq.proxy`` devices
 bridging into a standalone replay server (``replay.py:48-74``).
 
-The TPU topology DISSOLVES the replay server: replay lives in the learner's
-HBM (SURVEY.md §7), so the remote-ingest role collapses to one
+The default TPU topology DISSOLVES the replay server: replay lives in the
+learner's HBM (SURVEY.md §7), so the remote-ingest role collapses to one
 ROUTER on the learner that feeds the fused ingest+train step directly —
 C15's capability (other hosts feeding the learner) with one fewer hop and
-no shared-lock bottleneck (``origin_repo/README.md:11``).  What remains:
+no shared-lock bottleneck (``origin_repo/README.md:11``).  With
+``comms.replay_shards > 0`` the standalone replay role returns, sharded
+(:mod:`apex_tpu.replay_service`), built from the same primitives below:
+each shard's ROUTER speaks this module's chunk/ack protocol, and the
+:class:`ChunkSender` credit window points at shard ports via the
+``ip``/``port`` overrides.  What remains here:
 
 * :class:`ParamPublisher` / :class:`ParamSubscriber` — version-stamped
   latest-wins broadcast (SUB sets ``CONFLATE=1``: exactly the reference's
@@ -112,11 +117,15 @@ class ChunkSender:
     fire-and-forget on the same socket (no credit consumed)."""
 
     def __init__(self, comms: CommsConfig, identity: str,
-                 learner_ip: str | None = None):
+                 learner_ip: str | None = None, ip: str | None = None,
+                 port: int | None = None):
+        """``ip``/``port`` override the learner endpoint — the sharded
+        replay sender (:mod:`apex_tpu.replay_service.sender`) points the
+        same credit-windowed DEALER at a replay shard's ROUTER."""
         self.sock = _ctx().socket(zmq.DEALER)
         self.sock.setsockopt(zmq.IDENTITY, identity.encode())
-        ip = learner_ip or comms.learner_ip
-        self.sock.connect(f"tcp://{ip}:{comms.batch_port}")
+        target = ip or learner_ip or comms.learner_ip
+        self.sock.connect(f"tcp://{target}:{port or comms.batch_port}")
         self.max_outstanding = comms.max_outstanding_sends
         self._in_flight = 0
         # fleet observability: cumulative wire counters (shipped in

@@ -122,6 +122,16 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # sampled chunk-lineage trace events (persists across train() calls
     # like the checkpoint marks)
     _obs = None
+    # sharded replay service (apex_tpu/replay_service): when a
+    # ReplayServiceClient is attached, sampling lives in the shard fleet —
+    # the loop consumes pre-sampled batches (pipeline "batch" slots, or
+    # direct client polls on the serial path), trains via
+    # core.update_from_batch, and routes priority write-backs to the
+    # owning shard.  The chunk path stays live as the direct-ingest
+    # fallback (actors reroute to the learner when their shard wedges).
+    replay_client = None
+    _train_batch = None
+    service_steps = 0        # train steps taken on shard-served batches
 
     # -- param plane -------------------------------------------------------
 
@@ -222,6 +232,15 @@ class ConcurrentTrainer(CheckpointableTrainer):
             self._obs = obs_spans.LearnerObs(ring=ring)
         gap = self._dispatch_gap = DispatchGapTimer(ring=ring,
                                                     track="learner-hot-loop")
+        client = self.replay_client
+        if client is not None:
+            if getattr(self, "n_dp", 1) > 1:
+                raise ValueError(
+                    "replay service mode requires a dp=1 learner mesh — "
+                    "the shard fleet owns the replay; the dp>1 plan "
+                    "shards it in-learner (ROADMAP: service x dp mesh)")
+            if self._train_batch is None:
+                self._train_batch = self._make_batch_train()
         pipeline = None
         if self._use_pipeline():
             from apex_tpu.training.ingest_pipeline import IngestPipeline
@@ -239,7 +258,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # over the dispatch key chain (seeded with self.key;
                 # _dispatch_key writes the advanced chain state back)
                 sharded=sharded,
-                key=self.key if sharded is not None else None)
+                key=self.key if sharded is not None else None,
+                replay_client=client)
             self._pipeline = pipeline
             self._pipeline_base = self.ingested
         if self.fleet is None:
@@ -284,25 +304,38 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 stop = self._stop_requested
                 if now > t_end or (stop is not None and stop.is_set()):
                     break
+                # ``warm`` gates the LOCAL replay's train paths (train-only
+                # steps, fused chunk-train) — in service mode the local
+                # pool only fills through the fallback, so those paths
+                # stay cold until it genuinely warms.  The ratio budget
+                # and floor run on the EFFECTIVE ingest count (local +
+                # what the shard fleet reports), so service-mode training
+                # is budgeted against real fleet-wide ingest.
                 warm = self.ingested >= cfg.replay.warmup
+                ingested_eff = self.ingested + (
+                    client.ingested_total() if client is not None else 0)
                 consumed = self.steps_rate.total * self.core.batch_size
                 budget = (float("inf") if self.train_ratio is None
-                          else self.ingested * self.train_ratio
+                          else ingested_eff * self.train_ratio
                           / self.core.batch_size)
                 # Replay-ratio floor: learner behind -> pause draining so the
                 # bounded chunk queue backpressures the actor fleet.
                 behind = (warm and self.min_train_ratio is not None
-                          and consumed < self.ingested * self.min_train_ratio)
+                          and consumed < ingested_eff * self.min_train_ratio)
 
                 got_data = False
                 if pipeline is not None:
                     # pipelined: consume ready-on-device slots; the
                     # staging thread already polled/decoded/merged/staged
-                    # while the previous dispatch ran
+                    # while the previous dispatch ran.  Service mode
+                    # consumes even when "behind" — behind means the
+                    # learner owes MORE training, and batch slots are
+                    # exactly that
                     slot = None
-                    if not behind:
+                    if not behind or client is not None:
                         slot = pipeline.poll_slot(
-                            timeout=0 if warm else 0.05)
+                            timeout=0 if (warm or client is not None)
+                            else 0.05)
                     if slot is not None:
                         got_data = True
                         m = self._consume_slot(slot, warm, budget,
@@ -310,6 +343,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
                         if m is not None:
                             metrics = m
                 else:
+                    if client is not None \
+                            and self.steps_rate.total < budget:
+                        # serial service path: one pre-sampled batch per
+                        # iteration, write-back shipped inline
+                        item = client.poll_batch(timeout=0.02)
+                        if item is not None:
+                            got_data = True
+                            m = self._consume_slot(
+                                self._host_batch_slot(item), warm, budget,
+                                target_steps)
+                            if m is not None:
+                                metrics = m
                     # serial: scan dispatch (config.scan_steps > 1) asks
                     # for K chunks only when the learner can take all K
                     # steps within BOTH the ratio budget and the
@@ -429,7 +474,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
                          "actor_id": stat.actor_id}, episode_idx)
                     episode_idx += 1
 
-                if warm and metrics is not None \
+                # metrics is None until the first train dispatch, so the
+                # gate needs no warm check — and in service mode the
+                # LOCAL pool never warms while shard batches train fine
+                if metrics is not None \
                         and steps - self._last_log >= log_every:
                     extra = gap.snapshot()
                     if pipeline is not None:
@@ -437,12 +485,17 @@ class ConcurrentTrainer(CheckpointableTrainer):
                                   for k, v in pipeline.stats.items()}
                     if self._obs is not None:
                         extra |= self._obs.scalars()
+                    if client is not None:
+                        extra |= {"service_batches": client.batches,
+                                  "service_steps": self.service_steps,
+                                  "service_ingested":
+                                      client.ingested_total()}
                     self.log.scalars(
                         {k: float(v) for k, v in metrics.items()}
                         | {"bps": self.steps_rate.rate,
                            "fps": self.frames_rate.rate,
                            "param_version": self.param_version,
-                           "ingested": self.ingested} | extra, steps)
+                           "ingested": ingested_eff} | extra, steps)
                     self._last_log = steps
         finally:
             if pipeline is not None:
@@ -544,6 +597,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
         rejected = getattr(self.pool, "wire_rejected", None)
         snap["metrics"]["wire_rejected"] = (rejected()
                                             if callable(rejected) else 0)
+        if self.replay_client is not None:
+            c = self.replay_client
+            snap["metrics"]["replay_service"] = {
+                "shards": c.n_shards,
+                "batches_pulled": c.batches,
+                "service_steps": self.service_steps,
+                "ingested_total": c.ingested_total(),
+                "prio_sent": c.prio_sent,
+                "prio_dropped": c.prio_dropped,
+                "rejected": c.rejected,
+                "shard_status": c.shard_status(),
+            }
         return snap
 
     def _dump_fleet_summary(self) -> None:
@@ -614,12 +679,19 @@ class ConcurrentTrainer(CheckpointableTrainer):
         from apex_tpu.training.ingest_pipeline import PipelineState
         cfg = self.cfg
         pipe = self._pipeline
+        client = self.replay_client
         effective = self._pipeline_base + (0 if pipe is None
                                            else pipe.polled_total())
+        # service mode: the shard fleet's reported ingest counts toward
+        # the ratio budget (pulls ARE training), but NOT toward the
+        # local-chunk warmup prediction — fallback chunks train against
+        # the local pool, which only the local stream fills
+        client_tot = client.ingested_total() if client is not None else 0
         consumed = self.steps_rate.total * self.core.batch_size
         behind = (self.ingested >= cfg.replay.warmup
                   and self.min_train_ratio is not None
-                  and consumed < self.ingested * self.min_train_ratio)
+                  and consumed < (self.ingested + client_tot)
+                  * self.min_train_ratio)
         # the step counter the chunk will MEET includes the train steps
         # already staged ahead of it — without them every chunk queued
         # behind one pending fused step looks budget-eligible and the
@@ -629,10 +701,71 @@ class ConcurrentTrainer(CheckpointableTrainer):
                              else pipe.staged_train_steps()))
         budget_ok = (self.train_ratio is None
                      or steps_at_front
-                     < effective * self.train_ratio / self.core.batch_size)
+                     < (effective + client_tot) * self.train_ratio
+                     / self.core.batch_size)
         return PipelineState(
             behind=behind,
-            train_eligible=effective >= cfg.replay.warmup and budget_ok)
+            train_eligible=effective >= cfg.replay.warmup and budget_ok,
+            pull_eligible=budget_ok)
+
+    # -- sharded replay service (apex_tpu/replay_service) ------------------
+
+    def _make_batch_train(self):
+        """The service-mode train dispatch: the family's shared update
+        body over a shard-sampled batch (the sample half already ran on
+        the shard).  Families whose update consumes a PRNG key (AQL
+        NoisyNet) receive the shard-split update key with the batch, so
+        the one chain never forks."""
+        import jax as _jax
+        core = self.core
+        if getattr(core, "update_needs_key", False):
+            def train_on_batch(ts, batch, weights, key):
+                return core.update_from_batch(ts, batch, weights, key)
+        else:
+            def train_on_batch(ts, batch, weights):
+                return core.update_from_batch(ts, batch, weights)
+        return _jax.jit(train_on_batch, donate_argnums=(0,))
+
+    def _host_batch_slot(self, item: dict):
+        """Serial-path twin of the pipeline's ``_build_batch_slot``:
+        host arrays go straight into the dispatch (the jit call ingests
+        numpy operands; there is no staging thread to hide an H2D)."""
+        from apex_tpu.training.ingest_pipeline import StagedSlot
+        spans = obs_spans.spans_of(item)
+        obs_spans.stamp_spans(spans, "stage")
+        return StagedSlot(
+            kind="batch", payload=item["batch"],
+            prios=np.asarray(item["weights"], np.float32),
+            n_trans=0, planned_steps=1, spans=tuple(spans),
+            idx=np.asarray(item["idx"]),
+            shard=int(item.get("shard", 0)), seq=int(item["seq"]),
+            update_key=item.get("update_key"))
+
+    def _consume_batch_slot(self, slot):
+        """Train on one shard-sampled batch and route the priority
+        write-back to its owning shard (via the staging thread when the
+        pipeline is live — the device_get must not land on the hot
+        loop)."""
+        gap = self._dispatch_gap
+        gap.about_to_dispatch()
+        if slot.update_key is not None:
+            k = jax.random.wrap_key_data(jnp.asarray(slot.update_key))
+            self.train_state, prios, metrics = self._train_batch(
+                self.train_state, slot.payload, slot.prios, k)
+        else:
+            self.train_state, prios, metrics = self._train_batch(
+                self.train_state, slot.payload, slot.prios)
+        gap.dispatch_returned()
+        self.steps_rate.tick()
+        self.service_steps += 1
+        if self._pipeline is not None:
+            self._pipeline.write_back(slot.shard, slot.seq, slot.idx,
+                                      prios)
+        else:
+            self.replay_client.push_priorities(
+                slot.shard, slot.seq, slot.idx,
+                np.asarray(jax.device_get(prios), np.float32))
+        return metrics
 
     def _consume_slot(self, slot, warm: bool, budget: float,
                       target_steps: int):
@@ -647,6 +780,15 @@ class ConcurrentTrainer(CheckpointableTrainer):
         if obs is not None and slot.spans:
             obs.pre_consume(slot.spans)     # "consume": dispatch issued
         metrics = None
+        if slot.kind == "batch":
+            # shard-sampled: always trained (a staged batch skipped here
+            # would leave its strict shard wedged on the write-back it
+            # will never get; the budget re-check already gated the PULL,
+            # so overshoot is bounded by the staged depth)
+            metrics = self._consume_batch_slot(slot)
+            if obs is not None and slot.spans:
+                obs.post_consume(slot.spans)
+            return metrics
         if slot.kind == "scan":
             j = slot.chunks
             trainable = (warm and self._multi is not None

@@ -279,6 +279,10 @@ class PipelineState:
 
     behind: bool = False
     train_eligible: bool = True
+    #: replay-service mode only: may the staging thread pull another
+    #: pre-sampled batch?  The ratio budget alone (warmup is enforced
+    #: shard-side; pulling IS training, so the floor never gates it).
+    pull_eligible: bool = True
 
 
 @dataclass
@@ -299,6 +303,15 @@ class StagedSlot:
     n_trans: int
     n_per: tuple[int, ...] = ()
     chunks: int = 1
+    #: replay-service ``"batch"`` slots (payload = staged sample batch,
+    #: prios = staged IS weights): the sampled tree rows (host numpy —
+    #: they round-trip to the owning shard with the new priorities), the
+    #: owning shard/sequence ids, and the shard-split update key for
+    #: families whose update consumes one (AQL NoisyNet)
+    idx: object = None
+    shard: int = -1
+    seq: int = -1
+    update_key: object = None
     #: train steps this slot was STAGED to take (scan j / eligible single
     #: 1 / ingest-only 0) — folded into the budget prediction so chunks
     #: behind an unconsumed trainable slot see the step count they will
@@ -327,8 +340,17 @@ class IngestPipeline:
                  frame_capacity: int | None = None,
                  poll_timeout: float = 0.01,
                  put_device: bool | None = None,
-                 sharded=None, key=None, key_prefetch: int = 4):
+                 sharded=None, key=None, key_prefetch: int = 4,
+                 replay_client=None):
         self.pool = pool
+        # replay-service mode (apex_tpu/replay_service): the staging
+        # thread ALSO pulls pre-sampled batches round-robin from the
+        # shard fleet and ships priority write-backs back to the owning
+        # shard — the client's sockets are driven by this thread alone
+        # (RemotePool's migrate-then-use thread-affinity contract)
+        self.client = replay_client
+        self._wb_lock = threading.Lock()
+        self._wb_q: deque = deque()
         self.depth = max(1, int(depth))
         # dp>1 (``sharded`` = the ShardedLearner): every polled message is
         # one whole round-robin group; the scan stack doesn't apply (the
@@ -378,7 +400,8 @@ class IngestPipeline:
         self._polled_total = 0          # transitions EVER polled (monotone)
         self._staged_steps = 0          # planned train steps not yet consumed
         self.stats = {"slots": 0, "scan_slots": 0, "merged_slots": 0,
-                      "merged_chunks": 0, "publishes": 0}
+                      "merged_chunks": 0, "publishes": 0,
+                      "batch_slots": 0, "writebacks": 0}
         # obs plane: staging-thread activity lands on its own track of
         # the learner's trace ring (host clocks only — J006/J010 clean)
         self.ring = get_ring()
@@ -426,6 +449,14 @@ class IngestPipeline:
         with self._pub_lock:
             self._pub = (version, params)
 
+    def write_back(self, shard: int, seq: int, idx, priorities) -> None:
+        """Hand one consumed batch's TD priorities to the staging thread,
+        which performs the blocking ``device_get`` and ships them to the
+        owning shard — the write-back's host sync never lands on the hot
+        loop (the same discipline as param publishes)."""
+        with self._wb_lock:
+            self._wb_q.append((shard, seq, idx, priorities))
+
     def poll_slot(self, timeout: float = 0.0) -> StagedSlot | None:
         """Next ready slot in stream order, or None when the pipeline is
         dry (no slot staged, none in flight, and the pool poll came up
@@ -469,6 +500,23 @@ class IngestPipeline:
                 # next slot immediately where a sleep-poll would add a
                 # millisecond quantum per slot
                 st = self.state_fn()
+                if self.client is not None:
+                    # replay-service mode: ship pending write-backs, then
+                    # prefer a pre-sampled batch; the chunk path below
+                    # stays live as the direct-ingest FALLBACK (actors
+                    # reroute to the learner when their shard wedges)
+                    self._serve_writebacks()
+                    if st.pull_eligible:
+                        item = self.client.poll_batch(timeout=0)
+                        if item is not None:
+                            self._idle.clear()
+                            t0 = time.perf_counter()
+                            slot = self._build_batch_slot(item)
+                            self.ring.complete("stage_batch", t0,
+                                               time.perf_counter() - t0,
+                                               track="ingest-staging")
+                            self._put(slot)
+                            continue
                 if st.behind:
                     # replay-ratio floor: pause draining so the bounded
                     # worker queue backpressures the actor fleet
@@ -489,6 +537,11 @@ class IngestPipeline:
         except BaseException as exc:      # surface to poll_slot, loudly
             self._error = exc
             self._idle.set()
+            return
+        # clean stop: the shards are waiting on the final write-backs
+        # (strict ordering) — flush what the trainer queued before stop()
+        if self.client is not None:
+            self._serve_writebacks()
 
     def _poll(self, n: int, timeout: float = 0.0) -> list:
         msgs = self.pool.poll_chunks(n, timeout=timeout)
@@ -591,6 +644,42 @@ class IngestPipeline:
                 spans=tuple(spans))
         return slot
 
+    def _build_batch_slot(self, item: dict) -> StagedSlot:
+        """Stage one pre-sampled shard batch: the sample payload and IS
+        weights go on device ahead of the dispatch; the tree rows stay
+        host-side (they only round-trip back to the shard with the new
+        priorities)."""
+        spans = obs_spans.spans_of(item)
+        obs_spans.stamp_spans(spans, "stage")
+        with self._ahead_lock:
+            self._staged_steps += 1
+        self.stats["batch_slots"] += 1
+        self.stats["slots"] += 1
+        return StagedSlot(
+            kind="batch",
+            payload=self._stage(item["batch"]),
+            prios=self._stage(np.asarray(item["weights"], np.float32)),
+            n_trans=0, planned_steps=1, spans=tuple(spans),
+            idx=np.asarray(item["idx"]),
+            shard=int(item.get("shard", 0)), seq=int(item["seq"]),
+            update_key=item.get("update_key"))
+
+    def _serve_writebacks(self) -> None:
+        while True:
+            with self._wb_lock:
+                if not self._wb_q:
+                    return
+                shard, seq, idx, prios = self._wb_q.popleft()
+            t0 = time.perf_counter()
+            self.client.push_priorities(shard, seq, np.asarray(idx),
+                                        np.asarray(jax.device_get(prios),
+                                                   np.float32))
+            self.stats["writebacks"] += 1
+            self.ring.complete("prio_writeback", t0,
+                               time.perf_counter() - t0,
+                               track="ingest-staging",
+                               args={"shard": shard})
+
     def _single_slot(self, msg: dict, planned: int = 1) -> StagedSlot:
         self.stats["slots"] += 1
         if planned:
@@ -631,9 +720,12 @@ class IngestPipeline:
                 self._ring.put(slot, timeout=0.1)
                 return
             except queue_lib.Full:
-                # param publishes must not starve behind a full ring (the
-                # trainer may be deep in replay-only steps)
+                # param publishes (and shard write-backs — a strict shard
+                # is wedged until its priorities land) must not starve
+                # behind a full ring
                 self._serve_publish()
+                if self.client is not None:
+                    self._serve_writebacks()
                 continue
 
     def _serve_publish(self) -> None:

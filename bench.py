@@ -900,12 +900,19 @@ def _fleet_section(trainer) -> dict | None:
     if summary is None:
         return None
     m = summary["metrics"]
-    return {"peers": m["peers"], "alive": m["alive"],
-            "suspect": m["suspect"], "dead": m["dead"],
-            "parked": m["parked"], "rejoins": m["rejoins"],
-            "hb_gap_p50_s": m["hb_gap_p50_s"],
-            "hb_gap_p99_s": m["hb_gap_p99_s"],
-            "wire_rejected": m.get("wire_rejected", 0)}
+    out = {"peers": m["peers"], "alive": m["alive"],
+           "suspect": m["suspect"], "dead": m["dead"],
+           "parked": m["parked"], "rejoins": m["rejoins"],
+           "hb_gap_p50_s": m["hb_gap_p50_s"],
+           "hb_gap_p99_s": m["hb_gap_p99_s"],
+           "wire_rejected": m.get("wire_rejected", 0)}
+    if "replay_service" in m:
+        # sharded replay service (apex_tpu/replay_service): shard count,
+        # batches pulled, write-back/fallback counters, per-shard status
+        # — a chaos-killed shard's death is legible here next to the
+        # registry's dead count above
+        out["replay_service"] = m["replay_service"]
+    return out
 
 
 def bench_end_to_end(e2e_seconds: float) -> dict:

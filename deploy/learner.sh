@@ -13,8 +13,15 @@ cd apex-tpu
 [ -f /opt/apex-env/.provisioned-tpu ] || bash deploy/provision.sh tpu
 /opt/apex-env/bin/pip install -e . --no-deps
 
-# --mesh-dp defaults to 0 = all local chips; the runtime counts them itself
-tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs /opt/apex-env/bin/python -m apex_tpu.runtime \
+# --mesh-dp defaults to 0 = all local chips; the runtime counts them
+# itself.  Service mode (replay_shards > 0: the standalone replay plane,
+# apex_tpu/replay_service) requires a dp=1 learner mesh — the shard
+# fleet owns the replay; the dp>1 plan shards it in-learner.
+MESH_DP=0
+[ "${replay_shards}" -gt 0 ] && MESH_DP=1
+tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs \
+  APEX_REPLAY_SHARDS=${replay_shards} REPLAY_IP=${replay_ip} \
+  APEX_MESH_DP=$MESH_DP /opt/apex-env/bin/python -m apex_tpu.runtime \
   --role learner --env-id ${env_id} --n-actors ${n_actors} \
   --batch-size 512 --train-ratio 16 --min-train-ratio 2 \
   --checkpoint-dir /opt/apex-tpu/ckpts --barrier-timeout 1800 --verbose; read"

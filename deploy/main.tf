@@ -21,9 +21,13 @@ output "learner_ip" {
 
 # -- network ---------------------------------------------------------------
 # The reference opens 51001-51003 (replay) and 52001-52002 (learner)
-# (deploy.tf:64-126); without the replay server only the learner ports
-# remain: 51001 chunk ingest, 52001 param PUB, 52002 barrier, 52003
-# fleet status (`--role status` queries from any fleet node).
+# (deploy.tf:64-126).  Learner ports: 51001 chunk ingest (also stats,
+# heartbeats, and the actors' direct-ingest fallback when a replay shard
+# dies), 52001 param PUB, 52002 barrier, 52003 fleet status (`--role
+# status` queries from any fleet node).  Replay shards (replay_shards >
+# 0) additionally bind 53001 + shard_id on the replay host — one ROUTER
+# per shard carrying both the actors' hashed chunk streams and the
+# learner's pull/priority traffic.
 
 resource "google_compute_firewall" "apex_ports" {
   name    = "apex-tpu-ports"
@@ -34,8 +38,26 @@ resource "google_compute_firewall" "apex_ports" {
     ports    = ["51001", "52001", "52002", "52003", "6006"] # 6006: tensorboard
   }
 
-  source_tags = ["apex-actor", "apex-evaluator"]
+  # apex-replay sources: shard heartbeats ride the learner's chunk port
+  source_tags = ["apex-actor", "apex-evaluator", "apex-replay"]
   target_tags = ["apex-learner"]
+}
+
+resource "google_compute_firewall" "apex_replay_ports" {
+  name    = "apex-tpu-replay-ports"
+  network = "default"
+
+  allow {
+    protocol = "tcp"
+    # replay_port_base .. +15: shard s binds 53001 + s (CommsConfig
+    # .replay_port_base; 16 shards per host is the supported ceiling)
+    ports    = ["53001-53016"]
+  }
+
+  # actors push hashed chunks; the learner pulls batches + pushes
+  # priority write-backs
+  source_tags = ["apex-actor", "apex-learner"]
+  target_tags = ["apex-replay"]
 }
 
 # -- learner (TPU VM) ------------------------------------------------------
@@ -48,9 +70,15 @@ resource "google_tpu_v2_vm" "learner" {
 
   metadata = {
     startup-script = templatefile("${path.module}/learner.sh", {
-      repo_url = var.repo_url
-      env_id   = var.env_id
-      n_actors = var.actor_node_count * var.actors_per_node
+      repo_url      = var.repo_url
+      env_id        = var.env_id
+      n_actors      = var.actor_node_count * var.actors_per_node
+      replay_shards = var.replay_shards
+      # the instance NAME, not a resource reference: the replay host's
+      # startup script needs the learner's IP, so an IP reference here
+      # would be a terraform cycle — GCP's internal DNS resolves the
+      # name inside the VPC instead
+      replay_ip = var.replay_shards > 0 ? "apex-replay" : "127.0.0.1"
     })
   }
 
@@ -85,6 +113,40 @@ resource "google_compute_instance" "actor" {
     envs_per_actor  = var.envs_per_actor
     n_actors        = var.actor_node_count * var.actors_per_node
     learner_ip      = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
+    replay_shards   = var.replay_shards
+    replay_ip       = var.replay_shards > 0 ? "apex-replay" : "127.0.0.1"
+  })
+}
+
+# -- replay host (optional: replay_shards > 0) -----------------------------
+# The reference's standalone replay server restored, sharded
+# (apex_tpu/replay_service): one memory-heavy host runs N shard
+# processes, each owning one FramePoolReplay segment tree.  Actors hash
+# chunks to shards; the learner pulls pre-sampled batches round-robin.
+
+resource "google_compute_instance" "replay" {
+  count        = var.replay_shards > 0 ? 1 : 0
+  name         = "apex-replay"
+  machine_type = var.replay_machine_type
+  tags         = ["apex-replay"]
+
+  boot_disk {
+    initialize_params {
+      image = var.fleet_image
+      size  = 50
+    }
+  }
+
+  network_interface {
+    network = "default"
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile("${path.module}/replay.sh", {
+    repo_url      = var.repo_url
+    env_id        = var.env_id
+    replay_shards = var.replay_shards
+    learner_ip    = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
   })
 }
 

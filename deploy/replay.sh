@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Replay-host bootstrap (apex_tpu/replay_service — the reference's
+# standalone replay server restored, sharded): one tmux session per
+# shard process.  Shard s binds replay_port_base + s (53001 + s) and
+# heartbeats into the learner's chunk port, so the fleet registry runs
+# its JOINING/ALIVE/SUSPECT/DEAD machine over shards for free.
+set -euo pipefail
+command -v git >/dev/null || (apt-get update && apt-get install -y git)
+cd /opt
+git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
+cd apex-tpu
+# Baked image (deploy/packer): /opt/apex-env already provisioned; a fresh
+# VM provisions on first boot (idempotence marker makes respawns free).
+[ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
+/opt/apex-env/bin/pip install -e . --no-deps
+
+# Host supervisor (apex_tpu.fleet.supervise): a crashed shard respawns
+# with its tree EMPTY — the actors that hash to it refill it (their
+# chunks rerouted to the learner's direct ingest only while the port was
+# dark), and the learner's registry reports the DEAD -> ALIVE
+# transition.  A shard that keeps dying young exhausts the budget and
+# the supervisor halts loudly instead of crash-looping.
+s=0
+while [ $s -lt ${replay_shards} ]; do
+  tmux new -s "replay-$s" -d \
+    "JAX_PLATFORMS=cpu APEX_ROLE=replay SHARD_ID=$s \
+     APEX_REPLAY_SHARDS=${replay_shards} LEARNER_IP=${learner_ip} \
+     /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+       --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+       /opt/apex-env/bin/python -m apex_tpu.runtime \
+       --env-id ${env_id} --shard-id $s; read"
+  s=$(( s + 1 ))
+done

@@ -56,6 +56,18 @@ variable "actor_machine_type" {
   default = "n2-standard-8"
 }
 
+variable "replay_shards" {
+  type        = number
+  default     = 0
+  description = "Sharded replay service (apex_tpu/replay_service): N > 0 runs prioritized replay as N standalone shard processes on a dedicated replay host (reference topology: the r5.4xlarge replay node); 0 keeps replay in the learner's HBM. Shard s binds replay_port_base + s (53001 + s)."
+}
+
+variable "replay_machine_type" {
+  type        = string
+  default     = "n2-highmem-8"
+  description = "Replay host (reference: r5.4xlarge — replay is memory-bound: N shards x capacity frames resident)"
+}
+
 variable "evaluator_machine_type" {
   type    = string
   default = "n2-standard-4"

@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
 # Localhost all-roles topology (reference origin_repo/run.sh:1-5: tmux panes
-# for replay/learner/actor/eval on 127.0.0.1).  Replay is dissolved into the
-# learner here, so the topology is learner + N actors + evaluator.
+# for replay/learner/actor/eval on 127.0.0.1).  By default replay is
+# dissolved into the learner, so the topology is learner + N actors +
+# evaluator.  Export APEX_REPLAY_SHARDS=N (N > 0) to restore the
+# reference's standalone replay role as N shard processes
+# (apex_tpu/replay_service): actors hash chunks to shards, the learner
+# pulls pre-sampled batches round-robin and ships priority write-backs.
 #
 # Usage: scripts/run_local.sh [ENV_ID] [N_ACTORS] [TOTAL_STEPS] [ENVS_PER_ACTOR]
 set -euo pipefail
@@ -35,6 +39,12 @@ TRACE_DIR="${APEX_TRACE_DIR:-/tmp/apex-obs-$$}"
 export APEX_TRACE_DIR="$TRACE_DIR"
 mkdir -p "$TRACE_DIR"
 
+# Sharded replay service (apex_tpu/replay_service): the flag set below
+# must agree fleet-wide, so it rides COMMON like the ports do.  0 =
+# in-learner replay (the default topology).
+REPLAY_SHARDS="${APEX_REPLAY_SHARDS:-0}"
+export APEX_REPLAY_SHARDS="$REPLAY_SHARDS"
+
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
         --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500
@@ -43,6 +53,17 @@ COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
 pids=()
 cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
 trap cleanup EXIT
+
+if [ "$REPLAY_SHARDS" -gt 0 ]; then
+  # shard s binds replay_port_base + s; shards skip the startup barrier
+  # (useful the moment the ROUTER binds), so launch them first and the
+  # actor fleet's first sealed chunks route straight to them
+  for s in $(seq 0 $((REPLAY_SHARDS - 1))); do
+    python -m apex_tpu.runtime --role replay --shard-id "$s" \
+      "${COMMON[@]}" &
+    pids+=($!)
+  done
+fi
 
 for i in $(seq 0 $((N_ACTORS - 1))); do
   python -m apex_tpu.runtime --role actor --actor-id "$i" \

@@ -955,6 +955,58 @@ def test_j010_silent_on_non_time_receivers():
         """, "J010")
 
 
+# -- J011: pjit/shard_map sharding-annotation drift --------------------------
+
+def test_j011_fires_on_undeclared_axis_in_shard_map_specs():
+    # the drift: make_mesh declares ("dp", "tp"), the step annotates "mp"
+    assert fires("""
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.parallel.mesh import make_mesh, shard_map_compat
+        mesh = make_mesh(dp=4)
+        step = shard_map_compat(train, mesh=mesh,
+                                in_specs=(P(), P("mp")),
+                                out_specs=P("mp"))
+        """, "J011")
+
+
+def test_j011_fires_on_undeclared_axis_in_named_sharding():
+    assert fires("""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(devices, ("dp", "tp"))
+        sharding = NamedSharding(mesh, P("model"))
+        """, "J011")
+
+
+def test_j011_silent_on_declared_axes():
+    assert not fires("""
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.parallel.mesh import make_mesh, shard_map_compat
+        mesh = make_mesh(dp=4)
+        step = shard_map_compat(train, mesh=mesh,
+                                in_specs=(P(), P("dp"), P(("dp", "tp"))),
+                                out_specs=P("dp"))
+        """, "J011")
+
+
+def test_j011_silent_without_mesh_vocabulary():
+    # no mesh declared or imported: the rule cannot judge drift
+    assert not fires("""
+        from jax.sharding import PartitionSpec as P
+        step = wrap(train, in_specs=(P("rows"),), out_specs=P("rows"))
+        """, "J011")
+
+
+def test_j011_silent_on_specs_outside_annotation_surfaces():
+    # a P(...) passed to arbitrary helpers is not an annotation surface
+    # (axis names there are that helper's business)
+    assert not fires("""
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(dp=4)
+        layout = describe_layout(P("whatever"))
+        """, "J011")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

@@ -91,7 +91,39 @@ def test_firewall_ports_match_comms_config():
             c.status_port} <= ports
     assert 6006 in ports                     # tensorboard
     assert c.prios_port not in ports and c.sample_port not in ports, \
-        "replay-server ports resurrected — that server is dissolved"
+        "replay-server ports resurrected on the LEARNER — the learner " \
+        "hosts no replay sockets (the sharded service has its own rule)"
+
+
+def test_replay_firewall_range_matches_comms_config():
+    """The replay-host rule must open shard s's port (replay_port_base +
+    s) for every supported shard, with actors AND the learner as sources
+    (chunks in, pulls/write-backs in) — and the shard heartbeat path back
+    to the learner must include apex-replay as a source."""
+    from apex_tpu.config import CommsConfig
+
+    main = (DEPLOY / "main.tf").read_text()
+    m = re.search(
+        r'"apex_replay_ports"(.*?)target_tags\s*=\s*\[([^\]]*)\]',
+        main, re.DOTALL)
+    assert m, "no apex_replay_ports firewall resource"
+    body, targets = m.group(1), m.group(2)
+    r = re.search(r'"(\d+)-(\d+)"', body)
+    assert r, "replay firewall opens no port range"
+    lo, hi = int(r.group(1)), int(r.group(2))
+    c = CommsConfig()
+    assert lo == c.replay_port_base
+    assert hi >= c.replay_port_base + 15     # 16 shards per host
+    assert "apex-replay" in targets
+    src = re.search(r'source_tags\s*=\s*\[([^\]]*)\]', body).group(1)
+    assert "apex-actor" in src and "apex-learner" in src
+    # heartbeat return path: shard beats ride the learner's chunk port
+    learner_rule = re.search(
+        r'"apex_ports"(.*?)target_tags\s*=\s*\[([^\]]*)\]',
+        main, re.DOTALL).group(1)
+    learner_src = re.search(r'source_tags\s*=\s*\[([^\]]*)\]',
+                            learner_rule).group(1)
+    assert "apex-replay" in learner_src
 
 
 def test_provisioning_is_pinned_and_idempotent():
@@ -143,7 +175,7 @@ def test_role_scripts_use_baked_env():
     (baked image or first-boot fallback) — an unpinned system python is
     exactly the version skew the bake exists to kill."""
     for name, flavor in (("actor.sh", "cpu"), ("evaluator.sh", "cpu"),
-                         ("learner.sh", "tpu")):
+                         ("replay.sh", "cpu"), ("learner.sh", "tpu")):
         text = (DEPLOY / name).read_text()
         assert f"provision.sh {flavor}" in text, \
             f"{name}: no first-boot provisioning fallback"
@@ -182,7 +214,8 @@ def test_fleet_image_variable_wired():
     startup script."""
     main, declared, _ = _main_and_vars()
     assert "fleet_image" in declared
-    assert main.count("image = var.fleet_image") == 2   # actors + evaluator
+    # actors + evaluator + replay host
+    assert main.count("image = var.fleet_image") == 3
 
 
 def test_validate_binaries_if_available():
@@ -214,7 +247,7 @@ def test_bootstrap_scripts_use_host_supervisor():
     ActorPool respawn semantics for whole processes), which pairs with
     the roles' park/rejoin path.  The old inline ``while true`` loops
     must stay gone: they had no budget window and no jitter."""
-    for name in ("actor.sh", "evaluator.sh"):
+    for name in ("actor.sh", "evaluator.sh", "replay.sh"):
         text = (DEPLOY / name).read_text()
         assert "apex_tpu.fleet.supervise" in text, \
             f"{name}: role not launched under the host supervisor"

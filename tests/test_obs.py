@@ -447,7 +447,8 @@ def test_merge_dir_uses_fleet_summary_offsets(tmp_path):
             json.dump(_fake_trace(label, events), fh)
     with open(tmp_path / "fleet_summary.json", "w") as fh:
         json.dump({"peers": [{"identity": "actor-0",
-                              "clock_offset_s": -5.0}]}, fh)
+                              "clock_offset_s": -5.0,
+                              "clock_offset_n": 9}]}, fh)
     out = tmp_path / "merged.json"
     merged = obs_merge.merge_dir(str(tmp_path), str(out))
     assert out.exists()
@@ -455,6 +456,8 @@ def test_merge_dir_uses_fleet_summary_offsets(tmp_path):
              if ev.get("ph") == "X"]
     assert names == ["a", "b"]
     assert merged["traceEvents"][-1]["ts"] == pytest.approx(1e6)
+    # estimate quality rides the merged metadata for triage
+    assert merged["metadata"]["offset_samples"] == {"actor-0": 9}
 
 
 def test_merge_cli_main(tmp_path, capsys):
@@ -485,6 +488,72 @@ def test_registry_records_clock_offset_from_heartbeat_wall():
     snap = reg.snapshot()
     peer1 = next(p for p in snap["peers"] if p["identity"] == "actor-1")
     assert peer1["clock_offset_s"] is None
+
+
+def test_registry_offset_is_min_transit_median_not_last_beat():
+    """Each beat samples skew + transit; the published offset must be
+    the median of the SMALLEST half of the window (transit is strictly
+    additive, so small samples bound the skew), not whatever the last
+    beat happened to carry — one queue-dwell spike must not own the
+    estimate."""
+    from apex_tpu.config import CommsConfig
+    from apex_tpu.fleet.heartbeat import Heartbeat
+    from apex_tpu.fleet.registry import FleetRegistry, _min_transit_offset
+
+    wall = [0.0]
+    reg = FleetRegistry(CommsConfig(), clock=lambda: 1.0,
+                        wall_clock=lambda: wall[0])
+    # true skew 5.0; transits 0.0, 0.8, 0.1, 4.0 (spike), 0.2
+    for t, transit in ((100.0, 0.0), (102.0, 0.8), (104.0, 0.1),
+                       (106.0, 4.0), (108.0, 0.2)):
+        wall[0] = t + 5.0 + transit
+        reg.observe(Heartbeat("actor-0", wall_ts=t))
+    snap = reg.snapshot()
+    p = snap["peers"][0]
+    # smallest half of [5.0, 5.1, 5.2, 5.8, 9.0] -> [5.0, 5.1] -> 5.05
+    assert p["clock_offset_s"] == pytest.approx(5.05)
+    assert p["clock_offset_n"] == 5
+    # the helper's selection semantics, pinned directly
+    assert _min_transit_offset([7.0]) == 7.0
+    assert _min_transit_offset([5.0, 9.0]) == 5.0
+    assert _min_transit_offset([5.0, 5.2, 9.0, 5.1]) == \
+        pytest.approx(5.05)
+    # window bound: old samples age out (deque maxlen)
+    for i in range(40):
+        wall[0] = 200.0 + i + 2.0          # skew settles to 2.0
+        reg.observe(Heartbeat("actor-0", wall_ts=200.0 + i))
+    p = reg.snapshot()["peers"][0]
+    assert p["clock_offset_s"] == pytest.approx(2.0)
+    assert p["clock_offset_n"] == 16
+
+
+# -- R2D2 sequence messages: span-stamped at the source drain ----------------
+
+def test_r2d2_drain_grouped_stamps_sealed_spans(monkeypatch):
+    """The recurrent family's messages are born with a lineage span in
+    message METADATA (like drain_builder_chunks), so the merged timeline
+    covers R2D2 too — and the payload stays span-free (the learner's
+    fixed sequence-batch shapes depend on it)."""
+    from apex_tpu.actors.r2d2 import drain_grouped
+    from apex_tpu.obs import spans as obs_spans
+
+    def fake_seqs(n):
+        return [{"priority": np.float32(1.0), "n_new": 3,
+                 "obs": np.zeros((4, 2), np.float32),
+                 "action": np.zeros(4, np.int32)} for _ in range(n)]
+
+    ready = fake_seqs(5)
+    msgs = drain_grouped(ready, group=2)
+    assert len(msgs) == 2 and len(ready) == 1     # partial group buffered
+    for msg in msgs:
+        spans = obs_spans.spans_of(msg)
+        assert len(spans) == 1
+        assert "sealed" in spans[0]["hops"]
+        assert obs_spans.SPAN_KEY not in msg["payload"]
+    # the kill switch turns stamping off at the source
+    monkeypatch.setenv("APEX_OBS_SPANS", "0")
+    msgs = drain_grouped(fake_seqs(2), group=2)
+    assert obs_spans.SPAN_KEY not in msgs[0]
 
 
 # -- prometheus rendering ----------------------------------------------------

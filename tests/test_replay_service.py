@@ -1,0 +1,677 @@
+"""Sharded replay service (apex_tpu/replay_service): N=1 strict-mode
+bit-parity vs in-learner replay, chunk->shard hash stability, priority
+write-back routing, shard-kill degradation (registry DEAD + learner
+fallback), and hostile-payload rejection on the shard socket.
+
+The parity pin is the load-bearing test: with ``strict_order=True`` and
+one shard, the decomposed ingest -> sample -> update -> write-back
+program sequence must produce bit-identical params, replay-tree state,
+and PRNG key chain to the serial loop's fused dispatches under the same
+event schedule (each serial-loop event — ingest-only chunk, fused
+chunk+train, train-only step — maps to one shard driving sequence; the
+test drives the canonical one)."""
+
+import socket
+import threading
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.actors.pool import drain_builder_chunks
+from apex_tpu.config import CommsConfig, small_test_config
+from apex_tpu.models.dueling import DuelingDQN
+from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.replay_service import (ReplayServiceClient, ReplayShardCore,
+                                     ReplayShardServer, ShardedChunkSender,
+                                     chunk_shard, shard_warmup)
+from apex_tpu.runtime import transport, wire
+from apex_tpu.training.learner import LearnerCore
+from apex_tpu.training.state import create_train_state
+
+# -- fixtures ---------------------------------------------------------------
+
+FRAME_SHAPE = (3,)
+STACK = 2
+K = 8
+BATCH = 16
+WARMUP = 24
+
+
+def _chunk_messages(seed: int, n_chunks: int) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    builder = FrameChunkBuilder(2, 0.9, STACK, FRAME_SHAPE,
+                                chunk_transitions=K, frame_margin=4,
+                                frame_dtype=np.uint8)
+    msgs: list[dict] = []
+    while len(msgs) < n_chunks:
+        builder.begin_episode(rng.integers(0, 255, FRAME_SHAPE))
+        ep_len = int(rng.integers(1, 3 * K))
+        for t in range(ep_len):
+            builder.add_step(int(rng.integers(0, 4)), float(rng.normal()),
+                             rng.normal(size=4).astype(np.float32),
+                             rng.integers(0, 255, FRAME_SHAPE),
+                             terminated=t == ep_len - 1, truncated=False)
+        msgs.extend(drain_builder_chunks(builder))
+    return msgs[:n_chunks]
+
+
+def _pool_spec() -> FramePoolReplay:
+    return FramePoolReplay(capacity=64, frame_shape=FRAME_SHAPE,
+                           frame_stack=STACK, frame_capacity=128,
+                           frame_dtype="uint8")
+
+
+def _learner(seed=0):
+    """A compact (model, LearnerCore, TrainState) over the frame pool."""
+    model = DuelingDQN(num_actions=4, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=True)
+    replay = _pool_spec()
+    optimizer = make_optimizer(lr=1e-3, decay=0.95, eps=1e-7, centered=True,
+                               max_grad_norm=40.0, lr_decay_steps=100,
+                               lr_decay_rate=0.99)
+    ts = create_train_state(model, optimizer, jax.random.key(seed + 123),
+                            jnp.zeros((1, 3 * STACK), jnp.uint8))
+    core = LearnerCore(apply_fn=model.apply, replay=replay,
+                       optimizer=optimizer, batch_size=BATCH,
+                       target_update_interval=5)
+    return core, ts, replay
+
+
+def _beta(ingested: int, beta0=0.4, anneal=200) -> float:
+    frac = min(1.0, ingested / max(1, anneal))
+    return beta0 + (1.0 - beta0) * frac
+
+
+def _free_port_block(n: int, tries: int = 64) -> int:
+    """Base port with ``n`` consecutive free ports (shard s binds
+    base + s)."""
+    for _ in range(tries):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if base + n >= 65535:
+            continue
+        probes = []
+        try:
+            for i in range(n):
+                p = socket.socket()
+                p.bind(("127.0.0.1", base + i))
+                probes.append(p)
+            return base
+        except OSError:
+            continue
+        finally:
+            for p in probes:
+                p.close()
+    raise RuntimeError("no consecutive free port block found")
+
+
+def _comms(n_shards: int, **kw) -> CommsConfig:
+    base = _free_port_block(n_shards)
+    batch = _free_port_block(1)
+    return CommsConfig(replay_shards=n_shards, replay_port_base=base,
+                       batch_port=batch, **kw)
+
+
+# -- chunk -> shard hash ----------------------------------------------------
+
+def test_chunk_shard_hash_stable_and_uniform():
+    # the routing IS the sharding function: pin it to crc32 so any
+    # process (actor, shard, offline tooling) recomputes the same owner
+    for cid in ("actor-0:0", "actor-3:17", "evaluator-1-ab:5"):
+        for n in (1, 2, 4, 7):
+            assert chunk_shard(cid, n) == zlib.crc32(cid.encode()) % n
+    # regression pins (crc32 is platform-stable; these must never move)
+    assert chunk_shard("actor-0:0", 4) == zlib.crc32(b"actor-0:0") % 4
+    assert chunk_shard("x", 1) == 0 and chunk_shard("x", 0) == 0
+    # uniform-ish over realistic ids: no shard starves
+    counts = np.zeros(4, np.int64)
+    for a in range(8):
+        for s in range(256):
+            counts[chunk_shard(f"actor-{a}:{s}", 4)] += 1
+    assert counts.min() > 0.7 * counts.mean()
+
+
+def test_shard_warmup_split_preserves_global_gate():
+    assert shard_warmup(1000, 1) == 1000
+    assert shard_warmup(1000, 4) == 250
+    assert shard_warmup(1001, 4) == 251          # ceil: never train earlier
+    assert shard_warmup(3, 8) == 1
+
+
+# -- N=1 strict-mode bit-parity (the acceptance pin) ------------------------
+
+def test_n1_strict_service_bit_identical_to_in_learner():
+    """params + every replay-state field + the PRNG key chain after the
+    same event schedule: warmup ingest-only chunks, fused chunk+train
+    steps, then two train-only steps."""
+    msgs = _chunk_messages(3, 14)
+
+    # in-learner serial loop: fused ingest+train per warm chunk
+    core_a, ts_a, replay_a = _learner()
+    rs = replay_a.init()
+    fused = core_a.jit_fused_step()
+    ingest = core_a.jit_ingest()
+    train = core_a.jit_train_step()
+    key_a = jax.random.key(999)
+    ingested = 0
+    for msg in msgs:
+        prios = jnp.asarray(np.asarray(msg["priorities"], np.float32))
+        if ingested >= WARMUP:
+            key_a, k = jax.random.split(key_a)
+            ts_a, rs, _ = fused(ts_a, rs, msg["payload"], prios, k,
+                                jnp.float32(_beta(ingested)))
+        else:
+            rs = ingest(rs, msg["payload"], prios)
+        ingested += int(msg["n_trans"])
+    for _ in range(2):                   # learner outpacing ingest
+        key_a, k = jax.random.split(key_a)
+        ts_a, rs, _ = train(ts_a, rs, k, jnp.float32(_beta(ingested)))
+
+    # replay-service strict mode: same programs, decomposed across the
+    # shard (ingest/sample/write-back) and the learner (update)
+    core_b, ts_b, replay_b = _learner()
+    shard = ReplayShardCore(replay_b, jax.random.key(999), batch_size=BATCH,
+                            warmup=WARMUP, beta=0.4, beta_anneal=200,
+                            n_shards=1, strict_order=True)
+    train_b = jax.jit(core_b.update_from_batch, donate_argnums=(0,))
+
+    def pull_train_writeback():
+        nonlocal ts_b
+        b = shard.next_batch()
+        assert b is not None
+        ts_b, prios_out, _ = train_b(ts_b, b["batch"],
+                                     jnp.asarray(b["weights"]))
+        shard.write_back(b["seq"], b["idx"],
+                         np.asarray(jax.device_get(prios_out), np.float32))
+
+    for msg in msgs:
+        assert shard.can_ingest()
+        warm_pre = shard.warm
+        shard.ingest_msg(dict(msg))
+        if warm_pre:
+            pull_train_writeback()
+    for _ in range(2):
+        pull_train_writeback()
+
+    # params bitwise
+    for la, lb in zip(jax.tree.leaves(ts_a.params),
+                      jax.tree.leaves(ts_b.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert int(ts_a.step) == int(ts_b.step)
+    # replay tree state bitwise, field for field
+    for name in ("frames", "action", "reward", "discount", "obs_ids",
+                 "next_ids", "frame_epoch", "sum_tree", "min_tree",
+                 "pos", "f_epoch", "size", "max_priority"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rs, name)),
+            np.asarray(getattr(shard.state, name)), err_msg=name)
+    # key chain position
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(key_a)),
+        np.asarray(jax.random.key_data(shard.key)))
+
+
+# -- strict ordering / forgiveness ------------------------------------------
+
+def test_strict_shard_defers_ingest_and_forgives_dead_learner():
+    _, _, replay = _learner(seed=5)
+    shard = ReplayShardCore(replay, jax.random.key(5), batch_size=BATCH,
+                            warmup=WARMUP, strict_order=True)
+    msgs = iter(_chunk_messages(11, 20))
+    while not shard.warm:                # warm the shard (ingest-only)
+        assert shard.can_ingest()
+        shard.ingest_msg(next(msgs))
+    b = shard.next_batch()               # on-demand sample (learner idle)
+    assert b is not None and b["seq"] == 0
+    # outstanding write-back wedges both ingest and further sampling
+    assert shard.outstanding() == 1
+    assert not shard.can_ingest()
+    assert shard.next_batch() is None
+    # a learner death between pull and write-back must not wedge forever
+    assert shard.forgive_outstanding() == 1
+    assert shard.can_ingest()
+    # the late write-back for a forgiven batch is a counted duplicate
+    assert not shard.write_back(b["seq"], b["idx"],
+                                np.ones(BATCH, np.float32))
+    assert shard.dup_wb == 1
+    # lockstep resumes cleanly: a warm ingest pre-samples one batch, and
+    # a PROPER write-back reopens the ingest gate
+    shard.ingest_msg(next(msgs))
+    b = shard.next_batch()
+    assert b is not None and b["seq"] == 1
+    assert shard.write_back(b["seq"], b["idx"],
+                            np.ones(BATCH, np.float32))
+    assert shard.can_ingest() and shard.outstanding() == 0
+
+
+def test_loose_shard_presamples_ahead_and_never_defers():
+    _, _, replay = _learner(seed=6)
+    shard = ReplayShardCore(replay, jax.random.key(6), batch_size=BATCH,
+                            warmup=WARMUP, strict_order=False,
+                            presample_depth=2)
+    msgs = iter(_chunk_messages(12, 20))
+    while not shard.warm:
+        assert shard.can_ingest()
+        shard.ingest_msg(next(msgs))
+    for _ in range(4):                   # loose mode never waits
+        assert shard.can_ingest()
+        shard.ingest_msg(next(msgs))
+    # pre-sampled ahead, bounded by presample_depth
+    assert shard.stats()["outbox"] == 2
+    b0, b1 = shard.next_batch(), shard.next_batch()
+    assert (b0["seq"], b1["seq"]) == (0, 1)
+    # write-backs land out of band, in any order the wire delivers
+    assert shard.write_back(b1["seq"], b1["idx"],
+                            np.ones(BATCH, np.float32))
+    assert shard.wb_applied == 2
+
+
+# -- socket plane: routing, write-backs, fallback, hostile payloads ---------
+
+class _ShardFleet:
+    """N in-process ReplayShardServer threads over real TCP.
+
+    ``warmup`` defaults high so the send phases of the socket tests stay
+    wedge-free (a cold strict shard never defers ingest); tests that
+    want batches lower ``servers[s].core.warmup`` afterwards — a plain
+    GIL-atomic int the serving thread re-reads per message."""
+
+    def __init__(self, comms: CommsConfig, n: int, heartbeat=False,
+                 seed=77, warmup: int = 10_000):
+        self.comms = comms
+        self.servers = []
+        self.threads = []
+        self.stops = [threading.Event() for _ in range(n)]
+        for s in range(n):
+            _, _, replay = _learner(seed=seed + s)
+            core = ReplayShardCore(replay, jax.random.key(seed + s),
+                                   batch_size=BATCH, warmup=warmup,
+                                   n_shards=n, strict_order=True)
+            self.servers.append(ReplayShardServer(comms, s, core,
+                                                  bind_ip="127.0.0.1",
+                                                  heartbeat=heartbeat))
+        for s, srv in enumerate(self.servers):
+            t = threading.Thread(target=srv.run,
+                                 kwargs={"stop_event": self.stops[s]},
+                                 daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def kill(self, s: int) -> None:
+        """Take shard ``s`` off the air: stop its loop, close its ROUTER
+        (the port goes dark — senders see an exhausted credit window,
+        the learner's pulls go unanswered, heartbeats stop: the same
+        observable surface as a SIGKILL)."""
+        self.stops[s].set()
+        self.threads[s].join(timeout=10)
+        self.servers[s].close()
+
+    def close(self) -> None:
+        for s, stop in enumerate(self.stops):
+            if not stop.is_set():
+                stop.set()
+                self.threads[s].join(timeout=5)
+                self.servers[s].close()
+
+
+def test_sender_routes_by_hash_and_client_round_trips():
+    comms = _comms(2, max_outstanding_sends=2)
+    fleet = _ShardFleet(comms, 2)
+    sender = ShardedChunkSender(comms, "actor-0", shard_wait_s=5.0)
+    client = ReplayServiceClient(comms, identity="learner-t")
+    try:
+        msgs = _chunk_messages(21, 10)
+        expect = [0, 0]
+        for i, msg in enumerate(msgs):
+            cid = f"actor-0:{i}"
+            expect[chunk_shard(cid, 2)] += int(msg["n_trans"])
+            assert sender.send_chunk(dict(msg, chunk_id=cid))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            done = [srv.core.ingested for srv in fleet.servers]
+            if done == expect:
+                break
+            time.sleep(0.05)
+        assert [srv.core.ingested for srv in fleet.servers] == expect, \
+            "chunks landed on the wrong shard for their id hash"
+        assert sender.rerouted == 0
+
+        # warm both shards (ingest already happened above) and pull
+        # pre-sampled batches round-robin; write back to the OWNER
+        for srv in fleet.servers:
+            srv.core.warmup = 1
+        seen_shards = set()
+        for _ in range(2):
+            item = client.poll_batch(timeout=20)
+            assert item is not None
+            seen_shards.add(item["shard"])
+            assert client.push_priorities(item["shard"], item["seq"],
+                                          item["idx"],
+                                          np.ones(BATCH, np.float32))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(fleet.servers[s].core.wb_applied >= 1
+                   for s in seen_shards):
+                break
+            time.sleep(0.05)
+        for s in seen_shards:
+            assert fleet.servers[s].core.wb_applied >= 1, \
+                f"write-back never reached owning shard {s}"
+        assert client.ingested_total() > 0
+        assert {st["shard"] for st in client.shard_status()} == {0, 1}
+    finally:
+        client.close()
+        sender.close(drain_s=0)
+        fleet.close()
+
+
+def test_dead_shard_falls_back_to_learner_and_registry_marks_dead():
+    """The degradation contract: a dead shard's chunks reroute to the
+    learner's direct ingest, the survivor keeps serving batches, and the
+    registry (fed by shard heartbeats on the learner channel) walks
+    replay-0 through SUSPECT to DEAD."""
+    from apex_tpu.fleet.heartbeat import Heartbeat
+    from apex_tpu.fleet.registry import DEAD, FleetRegistry
+
+    comms = _comms(2, max_outstanding_sends=2, heartbeat_interval_s=0.2,
+                   suspect_after_s=1.0, dead_after_s=2.0)
+    receiver = transport.ChunkReceiver(comms, bind_ip="127.0.0.1",
+                                       queue_depth=64)
+    receiver.start()
+    fleet = _ShardFleet(comms, 2, heartbeat=True)
+    # shard_wait_s must comfortably exceed the survivor's first-chunk jit
+    # compile, or a slow-but-alive shard's chunks fall back too and the
+    # reroute accounting below goes soft
+    sender = ShardedChunkSender(comms, "actor-0", shard_wait_s=5.0)
+    registry = FleetRegistry(comms)
+    try:
+        def drain_beats():
+            while True:
+                try:
+                    stat = receiver.stats.get_nowait()
+                except Exception:
+                    return
+                if isinstance(stat, Heartbeat):
+                    registry.observe(stat)
+
+        # both shards beat into the learner channel -> ALIVE
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            drain_beats()
+            if {"replay-0", "replay-1"} <= set(registry.peers):
+                break
+            time.sleep(0.05)
+        assert {"replay-0", "replay-1"} <= set(registry.peers)
+
+        fleet.kill(0)
+        # chunks hashed to the dead shard reroute to the learner channel
+        # once its credit window exhausts (the first max_outstanding
+        # sends sit in the zmq buffer "in flight" — exactly what a
+        # process dying mid-buffer loses)
+        msgs = _chunk_messages(31, 12)
+        dead_shard_chunks = 0
+        for i, msg in enumerate(msgs):
+            cid = f"actor-0:{i}"
+            assert sender.send_chunk(dict(msg, chunk_id=cid), max_wait_s=8)
+            if chunk_shard(cid, 2) == 0:
+                dead_shard_chunks += 1
+        assert dead_shard_chunks > comms.max_outstanding_sends, \
+            "hash never filled shard 0's window — stream too short"
+        expected_fallback = dead_shard_chunks - comms.max_outstanding_sends
+        assert sender.rerouted == expected_fallback
+        deadline = time.monotonic() + 10
+        got = 0
+        while time.monotonic() < deadline and got < expected_fallback:
+            got += len(receiver_poll(receiver))
+            time.sleep(0.02)
+        assert got >= expected_fallback, \
+            "fallback chunks never reached the learner"
+
+        # the survivor keeps serving; registry walks replay-0 to DEAD
+        fleet.servers[1].core.warmup = 1
+        client = ReplayServiceClient(comms, identity="learner-t2")
+        try:
+            item = client.poll_batch(timeout=20)
+            assert item is not None and item["shard"] == 1
+        finally:
+            client.close()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            drain_beats()
+            registry.tick()
+            if registry.peers["replay-0"].state == DEAD:
+                break
+            time.sleep(0.1)
+        assert registry.peers["replay-0"].state == DEAD
+        assert registry.peers["replay-1"].state != DEAD
+        snap = registry.snapshot()
+        dead_roles = [p["role"] for p in snap["peers"]
+                      if p["state"] == DEAD]
+        assert dead_roles == ["replay"]
+    finally:
+        sender.close(drain_s=0)
+        fleet.close()
+        receiver.stop()
+
+
+def receiver_poll(receiver, n: int = 64) -> list:
+    out = []
+    for _ in range(n):
+        try:
+            out.append(receiver.chunks.get_nowait())
+        except Exception:
+            break
+    return out
+
+
+def test_shard_socket_rejects_hostile_payload_without_ack():
+    import pickle
+    import zmq
+
+    comms = _comms(1)
+    fleet = _ShardFleet(comms, 1)
+    try:
+        sock = zmq.Context.instance().socket(zmq.DEALER)
+        sock.setsockopt(zmq.IDENTITY, b"mallory")
+        sock.connect(f"tcp://127.0.0.1:{comms.replay_port_base}")
+
+        class Evil:
+            def __reduce__(self):
+                return (print, ("pwned",))
+
+        sock.send(pickle.dumps(("chunk", Evil())))
+        sock.send(wire.dumps(("not-a-kind", 1)))    # well-pickled garbage
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fleet.servers[0].rejected >= 2:
+                break
+            time.sleep(0.05)
+        assert fleet.servers[0].rejected >= 2
+        # no ack came back for either (an ack would grant hostile credit)
+        assert not sock.poll(200, zmq.POLLIN)
+        # and the shard still serves honest traffic afterwards
+        honest = ShardedChunkSender(comms, "actor-9", shard_wait_s=5.0)
+        try:
+            assert honest.send_chunk(dict(_chunk_messages(41, 1)[0],
+                                          chunk_id="actor-9:0"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if fleet.servers[0].core.chunks:
+                    break
+                time.sleep(0.05)
+            assert fleet.servers[0].core.chunks == 1
+        finally:
+            honest.close(drain_s=0)
+        sock.close(linger=0)
+    finally:
+        fleet.close()
+
+
+# -- chaos: the replay-shard fault gate -------------------------------------
+
+def test_replay_shard_chaos_drop_is_deterministic(monkeypatch):
+    from apex_tpu.fleet.chaos import ChaosConfig
+    from apex_tpu.replay_service.service import _ShardChaos
+
+    spec = {"drop_frac": 0.3, "kill": {"replay-1": 5}}
+
+    def run(identity):
+        chaos = _ShardChaos(ChaosConfig(7, spec).plan_for(identity))
+        return [chaos.on_chunk() for _ in range(50)], chaos.dropped
+
+    fates_a, dropped_a = run("replay-0")
+    fates_b, dropped_b = run("replay-0")
+    assert fates_a == fates_b and dropped_a == dropped_b > 0
+
+    # kill fires on the scheduled ingest index — and only for its shard
+    died = []
+    monkeypatch.setattr("apex_tpu.fleet.chaos._die",
+                        lambda ident, i: died.append((ident, i)) or
+                        (_ for _ in ()).throw(SystemExit))
+    chaos = _ShardChaos(ChaosConfig(7, spec).plan_for("replay-1"))
+    with pytest.raises(SystemExit):
+        for _ in range(50):
+            chaos.on_chunk()
+    assert died == [("replay-1", 5)]
+
+
+class _StubPool:
+    """No-chunk pool: the trainer must train on SERVICE batches alone."""
+
+    procs: list = []
+
+    def start(self):
+        pass
+
+    def cleanup(self):
+        pass
+
+    def poll_chunks(self, n, timeout=0.0):
+        if timeout:
+            time.sleep(min(timeout, 0.005))
+        return []
+
+    def poll_stats(self):
+        return []
+
+    def publish_params(self, version, params):
+        pass
+
+
+class _StubClient:
+    """Serves pre-fabricated batches with the client's interface; records
+    the write-backs the trainer routes back."""
+
+    def __init__(self, batches):
+        self._lock = threading.Lock()
+        self._batches = list(batches)
+        self.n_shards = 2
+        self.batches = 0
+        self.prio = []                   # (shard, seq) routed back
+        self.rejected = self.prio_sent = self.prio_dropped = 0
+
+    def poll_batch(self, timeout=0.0):
+        with self._lock:
+            if not self._batches:
+                return None
+            self.batches += 1
+            return self._batches.pop(0)
+
+    def push_priorities(self, shard, seq, idx, priorities):
+        assert np.asarray(priorities).dtype == np.float32
+        assert np.asarray(priorities).shape == np.asarray(idx).shape
+        with self._lock:
+            self.prio.append((int(shard), int(seq)))
+            self.prio_sent += 1
+        return True
+
+    def ingested_total(self):
+        return 4096                      # "the shard fleet is warm"
+
+    def shard_status(self):
+        return []
+
+    def close(self):
+        pass
+
+
+def test_trainer_trains_on_service_batches_and_routes_writebacks():
+    """Learner-side integration without sockets: with a replay client
+    attached and NO chunk stream, the trainer must train exclusively on
+    shard-served batches through the family's update body and route each
+    batch's TD priorities back to its owning shard — the local pool
+    never warms and is never sampled."""
+    from apex_tpu.training.apex import ApexTrainer, dqn_env_specs
+
+    cfg = small_test_config(capacity=256, batch_size=BATCH)
+    _, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+    rng = np.random.default_rng(0)
+
+    def fake_batch(shard, seq):
+        return {
+            "batch": {
+                "obs": rng.normal(size=(BATCH,) + stacked)
+                .astype(frame_dtype) if np.dtype(frame_dtype) != np.uint8
+                else rng.integers(0, 255, (BATCH,) + stacked, np.uint8),
+                "action": rng.integers(0, 2, BATCH).astype(np.int32),
+                "reward": rng.normal(size=BATCH).astype(np.float32),
+                "next_obs": rng.integers(0, 255, (BATCH,) + stacked,
+                                         np.uint8)
+                if np.dtype(frame_dtype) == np.uint8
+                else rng.normal(size=(BATCH,) + stacked)
+                .astype(frame_dtype),
+                "discount": np.full(BATCH, 0.97, np.float32),
+            },
+            "weights": np.ones(BATCH, np.float32),
+            "idx": rng.integers(0, 256, BATCH).astype(np.int32),
+            "seq": seq, "shard": shard, "ingested": 2048,
+        }
+
+    client = _StubClient([fake_batch(0, 0), fake_batch(1, 0),
+                          fake_batch(0, 1), fake_batch(1, 1)])
+    trainer = ApexTrainer(cfg, pool=_StubPool(), respawn_workers=False)
+    trainer.replay_client = client
+    p_before = np.asarray(
+        jax.tree.leaves(trainer.train_state.params)[0]).copy()
+    trainer.train(total_steps=4, max_seconds=120, log_every=2)
+
+    assert trainer.service_steps == 4
+    assert trainer.steps_rate.total == 4
+    assert sorted(client.prio) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert trainer.ingested == 0         # local pool untouched
+    p_after = np.asarray(jax.tree.leaves(trainer.train_state.params)[0])
+    assert not np.array_equal(p_before, p_after)
+    svc = trainer.fleet_summary()["metrics"]["replay_service"]
+    assert svc["service_steps"] == 4 and svc["batches_pulled"] == 4
+
+
+def test_build_shard_core_matches_trainer_replay_spec():
+    """One spec, two owners: the shard role must build the EXACT
+    FramePoolReplay the DQN learner builds, or N=1 parity (and every
+    frame shape on the wire) silently breaks."""
+    from apex_tpu.replay_service.service import (build_shard_core,
+                                                 dqn_replay_spec)
+    from apex_tpu.training.apex import dqn_env_specs
+
+    cfg = small_test_config(capacity=256, batch_size=16)
+    cfg = cfg.replace(comms=CommsConfig(replay_shards=2))
+    _, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    spec = dqn_replay_spec(cfg)
+    assert spec.frame_shape == frame_shape
+    assert spec.frame_stack == frame_stack
+    assert spec.capacity == cfg.replay.capacity
+    core = build_shard_core(cfg, shard_id=1)
+    assert core.replay == spec                   # frozen dataclass equality
+    assert core.warmup == shard_warmup(cfg.replay.warmup, 2)
+    assert core.n_shards == 2 and core.strict_order
+    with pytest.raises(NotImplementedError):
+        build_shard_core(cfg, 0, family="r2d2")

@@ -205,6 +205,34 @@ def test_cli_parser_roles_and_env_twins(monkeypatch):
     assert cfg4.actor.n_envs_per_actor == 32
 
 
+def test_cli_replay_service_flags_and_env_twins(monkeypatch):
+    """The replay-service topology flags ride the shared COMMON set with
+    env twins, like the ports — one export configures the whole fleet."""
+    from apex_tpu.runtime.cli import (build_parser, config_from_args,
+                                      identity_from_args)
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.comms.replay_shards == 0           # default: in-learner
+    assert cfg.comms.replay_strict_order
+
+    monkeypatch.setenv("APEX_REPLAY_SHARDS", "4")
+    monkeypatch.setenv("APEX_REPLAY_PORT_BASE", "54001")
+    monkeypatch.setenv("REPLAY_IP", "10.9.8.7")
+    monkeypatch.setenv("SHARD_ID", "2")
+    args = build_parser().parse_args(["--role", "replay"])
+    cfg = config_from_args(args)
+    assert cfg.comms.replay_shards == 4
+    assert cfg.comms.replay_port_base == 54001
+    assert args.shard_id == 2
+    assert identity_from_args(args).replay_ip == "10.9.8.7"
+    # flags beat env twins; --replay-loose flips the ordering contract
+    args = build_parser().parse_args(["--replay-shards", "2",
+                                      "--replay-loose"])
+    cfg = config_from_args(args)
+    assert cfg.comms.replay_shards == 2
+    assert not cfg.comms.replay_strict_order
+
+
 @pytest.mark.slow
 def test_actor_rejoin_after_kill_clears_silent_peers():
     """The supervisor-respawn contract (deploy/actor.sh + roles.py
