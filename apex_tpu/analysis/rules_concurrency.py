@@ -466,3 +466,80 @@ class NakedPickleLoads(Rule):
                 f"apex_tpu.runtime.wire.restricted_loads (add new message "
                 f"types to its allowlist, don't bypass it)"))
         return out
+
+
+# -- J012 -------------------------------------------------------------------
+
+
+def _is_port_name(name: str) -> bool:
+    return name.endswith("_port") or name.endswith("_port_base")
+
+
+@register
+class PortCollision(Rule):
+    id = "J012"
+    name = "port-collision"
+    description = ("two roles config-bound to the same literal port in one "
+                   "topology: a CommsConfig-style construction (or config "
+                   "class body) assigning the same constant to two "
+                   "*_port/*_port_base fields — the second bind dies with "
+                   "EADDRINUSE on one host, or two fleets silently "
+                   "cross-talk on separate hosts")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(ctx, node))
+            elif isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _collide(self, ctx: ModuleContext, node: ast.AST,
+                 ports: dict[str, int]) -> list[Finding]:
+        """One finding per duplicated literal value among ``ports``
+        (field -> constant).  Port 0 is exempt: it means ephemeral/
+        disabled, and N disabled planes are not one topology."""
+        by_value: dict[int, list[str]] = {}
+        for field, value in ports.items():
+            if value:
+                by_value.setdefault(value, []).append(field)
+        out = []
+        for value, fields in sorted(by_value.items()):
+            if len(fields) > 1:
+                out.append(ctx.finding(
+                    self, node,
+                    f"port collision: {', '.join(sorted(fields))} all "
+                    f"bound to {value} in one topology — every role "
+                    f"needs its own port (the second bind dies with "
+                    f"EADDRINUSE, or streams cross-talk)"))
+        return out
+
+    def _check_call(self, ctx: ModuleContext,
+                    call: ast.Call) -> list[Finding]:
+        ports = {k.arg: k.value.value for k in call.keywords
+                 if k.arg is not None and _is_port_name(k.arg)
+                 and isinstance(k.value, ast.Constant)
+                 and isinstance(k.value.value, int)}
+        return self._collide(ctx, call, ports) if len(ports) > 1 else []
+
+    def _check_class(self, ctx: ModuleContext,
+                     cls: ast.ClassDef) -> list[Finding]:
+        """Config-dataclass bodies: two port FIELDS defaulting to the
+        same literal are a collision baked into every fleet built from
+        the class."""
+        ports: dict[str, int] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    _is_port_name(stmt.target.id) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, int):
+                ports[stmt.target.id] = stmt.value.value
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and _is_port_name(t.id) \
+                            and isinstance(stmt.value, ast.Constant) \
+                            and isinstance(stmt.value.value, int):
+                        ports[t.id] = stmt.value.value
+        return self._collide(ctx, cls, ports) if len(ports) > 1 else []

@@ -244,6 +244,19 @@ class CommsConfig:
     rejoin_backoff_s: float = 1.0    # first retry delay (doubles per miss)
     rejoin_backoff_max_s: float = 8.0
     rejoin_attempt_s: float = 5.0    # per-attempt barrier/param race window
+    # -- registry reactions (PR 8: the registry ACTS, not just observes) ---
+    # When at least this fraction of actor-role peers is DEAD, the learner
+    # RELAXES its replay-ratio floor (min_train_ratio) so the surviving
+    # actors are not backpressured into starvation by a throughput target
+    # sized for the full fleet; the floor restores as peers rejoin.
+    # None = never relax.
+    relax_floor_dead_frac: float | None = 0.5
+    # A dead/respawned shard's traffic falls back to the learner; the
+    # actor re-probes the shard (credit window reset + one real send)
+    # every this many seconds so a RECOVERED shard gets its stream back
+    # without an actor restart (the stale credit window used to wedge it
+    # out forever).
+    shard_reprobe_s: float = 10.0
     # -- sharded replay service (apex_tpu/replay_service) ------------------
     # 0 = in-learner replay (replay dissolved into the learner's HBM, the
     # default since PR 0).  N > 0 restores the reference's standalone
@@ -266,6 +279,13 @@ class CommsConfig:
     # loose-mode pre-sample depth (batches staged ahead of the learner's
     # pulls); strict mode is structurally depth-1
     replay_presample: int = 2
+    # Shard durability: a shard snapshots its whole replay state (segment
+    # tree + frame pool + PRNG chain + counters) to the snapshot dir
+    # (--replay-snapshot-dir) at most every this many seconds — atomic
+    # tmp+rename, same discipline as fleet_summary.json — and a
+    # supervised respawn restores it, rejoining WARM instead of refilling
+    # from live streams.  0 = snapshots off (the pre-PR-8 behavior).
+    replay_snapshot_s: float = 0.0
 
 
 @dataclass(frozen=True)

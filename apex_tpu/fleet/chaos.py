@@ -12,7 +12,17 @@ Spec (``CHAOS_SPEC``, JSON; every key optional)::
     {"kill": {"actor-0": 30, "learner": 60},   # exit 137 at send/publish N
      "drop_frac": 0.1,                          # fraction of chunks dropped
      "delay_frac": 0.1, "delay_s": 0.05,        # fraction of chunks delayed
-     "stall_at": 20, "stall_s": 3.0}            # one publish stall window
+     "stall_at": 20, "stall_s": 3.0,            # one publish stall window
+     # partition-grade faults (PR 8):
+     "ack_withhold": {"at": 10, "n": 5, "hold_s": 3.0},  # learner ingress:
+     #   park the acks of chunks [at, at+n) for hold_s — credit windows
+     #   exhaust, senders retry, acks eventually flow: DELAY, never loss
+     "mute": ["replay-0"],                      # directional link drop:
+     #   the named role's OUTGOING replies vanish (its ingress stays up —
+     #   actor->shard up while shard->learner down)
+     "epoch_skew": {"learner": -1}}             # learner-epoch fencing:
+     #   skew this identity's outgoing replay write-back epochs (negative
+     #   = stale: shards must reject, count, and stay uncorrupted)
 
 Determinism: one RNG draw per message, streamed from
 ``seed ^ crc32(identity)``, so a message's fate depends only on (seed,
@@ -54,6 +64,14 @@ class ChaosPlan:
     delay_s: float = 0.05
     stall_at: int | None = None     # publish index to stall at
     stall_s: float = 0.0
+    # learner-ingress ack withholding (ChunkReceiver injects)
+    ack_withhold_at: int | None = None
+    ack_withhold_n: int = 1
+    ack_withhold_s: float = 3.0
+    # directional link drop: this identity's outgoing replies vanish
+    mute_replies: bool = False
+    # learner-epoch skew applied to outgoing replay write-backs
+    epoch_skew: int = 0
 
     def rng(self) -> random.Random:
         return random.Random(self.seed ^ zlib.crc32(self.identity.encode()))
@@ -71,6 +89,7 @@ class ChaosConfig:
         kill = self.spec.get("kill", {}).get(identity)
         if self.respawn_count > 0:
             kill = None             # kills are first-life only (see above)
+        aw = self.spec.get("ack_withhold") or {}
         return ChaosPlan(
             seed=self.seed, identity=identity,
             kill_at=kill,
@@ -78,7 +97,13 @@ class ChaosConfig:
             delay_frac=float(self.spec.get("delay_frac", 0.0)),
             delay_s=float(self.spec.get("delay_s", 0.05)),
             stall_at=self.spec.get("stall_at"),
-            stall_s=float(self.spec.get("stall_s", 0.0)))
+            stall_s=float(self.spec.get("stall_s", 0.0)),
+            ack_withhold_at=aw.get("at"),
+            ack_withhold_n=int(aw.get("n", 1)),
+            ack_withhold_s=float(aw.get("hold_s", 3.0)),
+            mute_replies=identity in self.spec.get("mute", ()),
+            epoch_skew=int(self.spec.get("epoch_skew", {})
+                           .get(identity, 0)))
 
 
 def chaos_from_env(environ=None) -> ChaosConfig | None:
@@ -137,6 +162,11 @@ class ChaosChunkSender:
     def reset_credits(self) -> None:
         self.inner.reset_credits()
 
+    def note_resend(self) -> None:
+        note = getattr(self.inner, "note_resend", None)
+        if note is not None:
+            note()
+
     @property
     def chunks_sent(self) -> int:
         return self.inner.chunks_sent
@@ -144,6 +174,14 @@ class ChaosChunkSender:
     @property
     def acks_received(self) -> int:
         return self.inner.acks_received
+
+    @property
+    def resends(self) -> int:
+        return getattr(self.inner, "resends", 0)
+
+    @property
+    def rerouted(self) -> int:
+        return getattr(self.inner, "rerouted", 0)
 
     def close(self, *a, **kw) -> None:
         self.inner.close(*a, **kw)

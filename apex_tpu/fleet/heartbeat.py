@@ -38,6 +38,12 @@ class Heartbeat:
     rejoins: int = 0                # park -> resume cycles this process
     parked: bool = False
     dropped_stats: int = 0          # same carry semantics as EpisodeStat
+    # sender-window recovery accounting (PR 8): bounded sends retried on
+    # credit exhaustion, and chunks rerouted to the learner-direct
+    # fallback when the owning replay shard wedged.  Cumulative, like
+    # chunks_sent/acks_received.
+    resends: int = 0
+    rerouted: int = 0
     # sender wall clock at beat creation (0.0 = unstamped): the learner's
     # registry differences it against its own wall clock into a per-peer
     # clock offset (skew + transit) — the alignment input for
@@ -97,4 +103,6 @@ class HeartbeatEmitter:
             chunks_sent=int(counters.get("chunks_sent", 0)),
             acks_received=int(counters.get("acks_received", 0)),
             rejoins=int(rejoins), parked=bool(parked),
+            resends=int(counters.get("resends", 0)),
+            rerouted=int(counters.get("rerouted", 0)),
             wall_ts=time.time())

@@ -55,6 +55,13 @@ class ParkController:
         self.parked = False
         self.parks = 0
         self.rejoins = 0
+        # learner-epoch fencing (PR 8): rejoins split by what the epoch
+        # stamp on the first post-park publish proved — a RESTARTED
+        # learner (epoch bumped: the outstanding ack window died with it,
+        # reset credits) vs a merely STALLED one (same epoch: the acks
+        # are still coming, a reset would over-credit the window)
+        self.restarts_seen = 0
+        self.stall_resumes = 0
         # deterministic jitter per identity: a fleet parked by one learner
         # death must not retry in lockstep (thundering-herd barrier hellos)
         self._rng = random.Random(zlib.crc32(identity.encode()))
@@ -113,6 +120,9 @@ class ParkController:
 
         self.parked = True
         self.parks += 1
+        # the epoch we last saw params under: the rejoin's restart-vs-
+        # stall verdict compares the resumed stream's stamp against this
+        self._epoch_at_park = getattr(sub, "learner_epoch", 0)
         backoff = self.comms.rejoin_backoff_s
         try:
             while not self.stop_event.is_set():
@@ -135,7 +145,14 @@ class ParkController:
 
     def _await_params(self, sub):
         """Barrier released (or the stream twitched): wait out the
-        learner's first publish, then account the rejoin."""
+        learner's first publish, then account the rejoin.
+
+        Epoch fencing decides the credit-window question: an epoch-
+        stamped stream that resumed under the SAME epoch is a stalled
+        learner whose outstanding acks are still in flight — resetting
+        would over-credit the window — while a bumped (or unstamped)
+        epoch means a restart took the acks with it, so the window
+        resets exactly as before fencing existed."""
         deadline = self._clock() + 4 * self.comms.rejoin_attempt_s
         while not self.stop_event.is_set() and self._clock() < deadline:
             got = sub.poll(200)
@@ -143,9 +160,18 @@ class ParkController:
                 self.note_params()
                 self._pending = got
                 self.rejoins += 1
-                if self.sender is not None:
-                    # the dead learner never acked the in-flight window;
-                    # a stale window wedges the first post-rejoin send
-                    self.sender.reset_credits()
+                epoch = getattr(sub, "learner_epoch", 0)
+                pre = getattr(self, "_epoch_at_park", 0)
+                stalled = bool(epoch) and epoch == pre
+                if stalled:
+                    self.stall_resumes += 1
+                else:
+                    if epoch and pre and epoch != pre:
+                        self.restarts_seen += 1
+                    if self.sender is not None:
+                        # the dead learner never acked the in-flight
+                        # window; a stale window wedges the first
+                        # post-rejoin send
+                        self.sender.reset_credits()
                 return got
         return None

@@ -62,6 +62,8 @@ class PeerState:
     param_version: int = 0
     chunks_sent: int = 0
     acks_received: int = 0
+    resends: int = 0
+    rerouted: int = 0
     rejoins_reported: int = 0
     parked: bool = False
     beats: int = 0
@@ -133,6 +135,8 @@ class FleetRegistry:
             p.role, p.pid, p.host = hb.role, hb.pid, hb.host
             p.fps, p.param_version = hb.fps, hb.param_version
             p.chunks_sent, p.acks_received = hb.chunks_sent, hb.acks_received
+            p.resends = getattr(hb, "resends", 0)
+            p.rerouted = getattr(hb, "rerouted", 0)
             p.rejoins_reported = max(p.rejoins_reported, hb.rejoins)
             p.parked = hb.parked
             wall_ts = getattr(hb, "wall_ts", 0.0)
@@ -187,6 +191,16 @@ class FleetRegistry:
             return self.dead_to_alive + sum(p.rejoins_reported
                                             for p in self.peers.values())
 
+    def dead_fraction(self, roles: tuple[str, ...] = ("actor",)) -> float:
+        """Fraction of the peers in ``roles`` currently DEAD — the input
+        to the learner's replay-ratio-floor reaction (0.0 while no such
+        peer has ever registered: an empty fleet is not a dead one)."""
+        with self._lock:
+            peers = [p for p in self.peers.values() if p.role in roles]
+            if not peers:
+                return 0.0
+            return sum(p.state == DEAD for p in peers) / len(peers)
+
     def _gap_percentiles(self) -> tuple[float | None, float | None]:
         if not self._gaps:
             return None, None
@@ -228,6 +242,7 @@ class FleetRegistry:
                 "param_version": p.param_version,
                 "chunks_sent": p.chunks_sent,
                 "acks_received": p.acks_received,
+                "resends": p.resends, "rerouted": p.rerouted,
                 "rejoins": p.rejoins_reported, "parked": p.parked,
                 "beats": p.beats, "deaths": p.deaths,
                 "silent_s": round(now - p.last_any, 1),
@@ -275,12 +290,16 @@ class FleetStatusServer:
     """
 
     def __init__(self, comms: CommsConfig, registry: FleetRegistry,
-                 bind_ip: str = "*", metrics_fn=None):
+                 bind_ip: str = "*", metrics_fn=None, snapshot_fn=None):
         import zmq
 
         self._zmq = zmq
         self.registry = registry
         self.metrics_fn = metrics_fn
+        # optional richer status payload (the trainer's fleet_summary —
+        # registry snapshot PLUS reaction/replay-service/drain metrics);
+        # scale supervisors key off those extras, so the trainer passes it
+        self.snapshot_fn = snapshot_fn
         self.sock = zmq.Context.instance().socket(zmq.REP)
         self.sock.bind(f"tcp://{bind_ip}:{comms.status_port}")
         self._stop = threading.Event()
@@ -309,7 +328,13 @@ class FleetStatusServer:
                     text = f"# metrics unavailable: {type(e).__name__}\n"
                 self.sock.send(text.encode("utf-8", errors="replace"))
             else:                       # any other frame means "status"
-                self.sock.send(wire.dumps(self.registry.snapshot()))
+                try:
+                    snap = (self.snapshot_fn()
+                            if self.snapshot_fn is not None
+                            else self.registry.snapshot())
+                except Exception:       # a status query must never wedge
+                    snap = self.registry.snapshot()
+                self.sock.send(wire.dumps(snap))
 
     def stop(self) -> None:
         self._stop.set()

@@ -122,6 +122,52 @@ def scalar_tails(history: dict) -> dict:
     return out
 
 
+def make_http_sidecar(comms, port: int, learner_ip: str | None = None,
+                      bind: str = "0.0.0.0", timeout_s: float = 5.0):
+    """Plain-HTTP adapter over the zmq-REQ metrics surface (PR 6
+    follow-up): returns an ``http.server`` instance whose ``GET
+    /metrics`` (or ``/``) proxies one :func:`metrics_request` round-trip
+    per scrape, so a stock Prometheus server polls the fleet directly —
+    no textfile collector, no custom scrape tooling.  The caller drives
+    ``serve_forever()``; an unreachable learner answers 503 with a
+    comment line, never an empty 200 (Prometheus marks the target down
+    instead of recording a silent gap)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):           # noqa: N802 (http.server's spelling)
+            path = self.path.split("?", 1)[0]
+            if path not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                text = metrics_request(comms, learner_ip=learner_ip,
+                                       timeout_s=timeout_s)
+            except Exception as e:  # a scrape must never kill the sidecar
+                text = None
+                err = f"{type(e).__name__}"
+            else:
+                err = "no reply"
+            if text is None:
+                body = (f"# learner metrics unavailable ({err}) at "
+                        f"{learner_ip or comms.learner_ip}:"
+                        f"{comms.status_port}\n").encode()
+                self.send_response(503)
+            else:
+                body = text.encode("utf-8", errors="replace")
+                self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args) -> None:
+            pass                    # scrape-per-15s noise stays off stdout
+
+    return ThreadingHTTPServer((bind, port), _Handler)
+
+
 def metrics_request(comms, learner_ip: str | None = None,
                     timeout_s: float = 5.0) -> str | None:
     """Client half of the scrape: one REQ ``b"metrics"`` round-trip to

@@ -69,7 +69,9 @@ class ChunkAggregator:
 
     def __getattr__(self, name):
         if name in ("dead_workers", "respawn_worker", "worker_deaths",
-                    "silent_peers", "peer_seen", "wire_rejected"):
+                    "silent_peers", "peer_seen", "wire_rejected",
+                    "set_learner_epoch", "rejoin_admitted",
+                    "acks_withheld"):
             return getattr(self.pool, name)
         raise AttributeError(name)
 

@@ -65,11 +65,22 @@ class ReplayServiceClient:
         self._outstanding = [False] * self.n_shards
         self._last_pull = [0.0] * self.n_shards
         self._ingested = [0] * self.n_shards
+        self._stale_wb = [0] * self.n_shards    # shard-reported rejects
+        self._restored = [0] * self.n_shards    # shard-reported warm state
         self.batches = 0
         self.rejected = 0           # replies outside the wire allowlist
         self.prio_sent = 0
         self.prio_dropped = 0       # write-backs a full send queue refused
         self.unanswered = [0] * self.n_shards   # consecutive pull retries
+        # learner-epoch fencing: the trainer stamps this before training
+        # starts; 0 = unstamped legacy traffic (shard fencing stays off).
+        # The chaos harness can SKEW outgoing write-back epochs (identity
+        # "learner") to drill the shards' stale-epoch rejection.
+        self.learner_epoch = 0
+        from apex_tpu.fleet.chaos import chaos_from_env
+        chaos = chaos_from_env()
+        self.epoch_skew = (chaos.plan_for(identity).epoch_skew
+                           if chaos is not None else 0)
 
     # -- pulls ---------------------------------------------------------------
 
@@ -78,8 +89,10 @@ class ReplayServiceClient:
             return
         if self._outstanding[s]:
             self.unanswered[s] += 1     # retry: the last pull went silent
+        msg = (("pull", self.learner_epoch) if self.learner_epoch
+               else ("pull",))
         try:
-            self.socks[s].send(wire.dumps(("pull",)), self._zmq.DONTWAIT)
+            self.socks[s].send(wire.dumps(msg), self._zmq.DONTWAIT)
             self._outstanding[s] = True
             self._last_pull[s] = now
         except self._zmq.Again:
@@ -109,6 +122,10 @@ class ReplayServiceClient:
                 info = msg[1]
                 self._ingested[s] = max(self._ingested[s],
                                         int(info.get("ingested", 0)))
+                self._stale_wb[s] = max(self._stale_wb[s],
+                                        int(info.get("stale_wb", 0)))
+                self._restored[s] = max(self._restored[s],
+                                        int(info.get("restored", 0)))
         return None
 
     def poll_batch(self, timeout: float = 0.0) -> dict | None:
@@ -140,10 +157,15 @@ class ReplayServiceClient:
                         priorities) -> bool:
         """Ship one batch's TD priorities to its owning shard.  Non-
         blocking: a dead shard's write-backs are counted and dropped (it
-        forgives them server-side), never wedge the learner."""
+        forgives them server-side), never wedge the learner.  Each
+        write-back carries the learner epoch (plus any chaos skew) so a
+        restarted learner's shards can fence its predecessor's ghosts."""
+        epoch = (max(0, self.learner_epoch + self.epoch_skew)
+                 if self.learner_epoch else 0)
         payload = wire.dumps(("prio", int(seq),
                               np.asarray(idx),
-                              np.asarray(priorities, np.float32)))
+                              np.asarray(priorities, np.float32),
+                              int(epoch)))
         try:
             self.socks[int(shard)].send(payload, self._zmq.DONTWAIT)
             self.prio_sent += 1
@@ -161,7 +183,9 @@ class ReplayServiceClient:
 
     def shard_status(self) -> list[dict]:
         return [{"shard": s, "ingested": self._ingested[s],
-                 "unanswered": self.unanswered[s]}
+                 "unanswered": self.unanswered[s],
+                 "stale_wb": self._stale_wb[s],
+                 "restored": self._restored[s]}
                 for s in range(self.n_shards)]
 
     def close(self) -> None:

@@ -45,7 +45,8 @@ class ShardedChunkSender:
     def __init__(self, comms: CommsConfig, identity: str,
                  direct: transport.ChunkSender | None = None,
                  n_shards: int | None = None, replay_ip: str | None = None,
-                 shard_wait_s: float = 2.0):
+                 shard_wait_s: float = 2.0,
+                 shard_reprobe_s: float | None = None):
         self.comms = comms
         self.identity = identity
         self.n_shards = n_shards or comms.replay_shards
@@ -63,8 +64,22 @@ class ShardedChunkSender:
         # one (run_actor constructs it first so ParkController sees it)
         self.direct = direct or transport.ChunkSender(comms, identity)
         self.shard_wait_s = float(shard_wait_s)
+        # dead-shard re-probe (PR 8 fix): a dying shard takes the
+        # in-flight acks with it, so its credit window stays exhausted
+        # FOREVER and every later chunk falls back — a recovered
+        # (respawned, registry-ALIVE) shard never got its traffic back
+        # without an actor restart.  Every shard_reprobe_s of continuous
+        # fallback the window resets and one real send probes the shard:
+        # a live shard acks and the stream returns; a still-dead one
+        # re-wedges after max_outstanding probes (bounded loss, same as
+        # any chunk in a dead shard's socket buffer).
+        self.shard_reprobe_s = (comms.shard_reprobe_s
+                                if shard_reprobe_s is None
+                                else float(shard_reprobe_s))
+        self._down_since: list[float | None] = [None] * self.n_shards
         self._seq = 0
         self.rerouted = 0           # chunks that fell back to the learner
+        self.reprobes = 0           # credit-reset probes of wedged shards
 
     # -- data plane ----------------------------------------------------------
 
@@ -75,6 +90,8 @@ class ShardedChunkSender:
         (None = block, ``max_wait_s`` = bounded) apply to the fallback
         channel, so park-controller wedge detection keys off LEARNER
         liveness exactly as in the unsharded topology."""
+        import time
+
         cid = msg.get("chunk_id")
         if cid is None:
             cid = msg["chunk_id"] = f"{self.identity}:{self._seq}"
@@ -83,10 +100,22 @@ class ShardedChunkSender:
         wait = self.shard_wait_s
         if max_wait_s is not None:
             wait = min(wait, max_wait_s)
+        down = self._down_since[s]
+        if (down is not None and self.shard_reprobe_s > 0
+                and time.monotonic() - down >= self.shard_reprobe_s):
+            # the shard has been wedged a full re-probe period: its old
+            # acks are never coming (a respawned process has no memory
+            # of them) — reset the window and give it one real send
+            self.shards[s].reset_credits()
+            self.reprobes += 1
+            self._down_since[s] = time.monotonic()
         if self.shards[s].send_chunk(msg, stop_event, max_wait_s=wait):
+            self._down_since[s] = None      # the shard is taking traffic
             return True
         if stop_event is not None and stop_event.is_set():
             return False
+        if self._down_since[s] is None:
+            self._down_since[s] = time.monotonic()
         self.rerouted += 1
         return self.direct.send_chunk(msg, stop_event,
                                       max_wait_s=max_wait_s)
@@ -104,6 +133,11 @@ class ShardedChunkSender:
         for s in self.shards:
             s.reset_credits()
 
+    def note_resend(self) -> None:
+        """Adapter retry accounting rides the learner channel's counter
+        (the bounded-wait fallback send is what the adapter retries)."""
+        self.direct.note_resend()
+
     @property
     def chunks_sent(self) -> int:
         return (self.direct.chunks_sent
@@ -113,6 +147,11 @@ class ShardedChunkSender:
     def acks_received(self) -> int:
         return (self.direct.acks_received
                 + sum(s.acks_received for s in self.shards))
+
+    @property
+    def resends(self) -> int:
+        return (self.direct.resends
+                + sum(s.resends for s in self.shards))
 
     def close(self, drain_s: float = 2.0) -> None:
         for s in self.shards:

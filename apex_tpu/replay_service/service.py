@@ -133,7 +133,8 @@ class ReplayShardServer:
 
     def __init__(self, comms: CommsConfig, shard_id: int,
                  core: ReplayShardCore, bind_ip: str = "*",
-                 heartbeat=True):
+                 heartbeat=True, snapshot_path: str | None = None,
+                 snapshot_s: float | None = None):
         import zmq
 
         from apex_tpu.fleet.chaos import chaos_from_env
@@ -149,9 +150,23 @@ class ReplayShardServer:
         self.batches_served = 0
         self._inbox: list = []          # strict-mode deferred (ident, msg)
         self._last_wb = time.monotonic()
+        # shard durability: periodic whole-state snapshots (taken only at
+        # quiescent points so a restore resumes the strict lockstep
+        # bit-exactly); a supervised respawn restores the newest one
+        self.snapshot_path = snapshot_path
+        self.snapshot_s = (comms.replay_snapshot_s if snapshot_s is None
+                           else snapshot_s)
+        self._last_snapshot = time.monotonic()
+        self.snapshots = 0
+        self.snapshot_errors = 0
         chaos = chaos_from_env()
-        self.chaos = _ShardChaos(chaos.plan_for(self.identity)
-                                 if chaos is not None else None)
+        plan = chaos.plan_for(self.identity) if chaos is not None else None
+        self.chaos = _ShardChaos(plan)
+        # directional link drop (shard->learner down while actor->shard
+        # stays up): this shard's outgoing replies vanish — the learner's
+        # pulls arrive, the sampled batches never make it back
+        self._mute = bool(plan is not None and plan.mute_replies)
+        self.chaos_muted = 0
         self._hb = None
         self._hb_sender = None
         if heartbeat:
@@ -188,19 +203,37 @@ class ReplayShardServer:
                 self._hb.tick(int(msg.get("n_trans", 0)))
             self.sock.send_multipart([ident, b"ack"])
 
-    def _handle_pull(self, ident: bytes) -> None:
+    def _handle_pull(self, ident: bytes, epoch: int = 0) -> None:
+        forgiven = self.core.note_epoch(int(epoch))
+        if forgiven:
+            # a restarted learner's first pull: its predecessor's
+            # outstanding write-backs are gone with it — unwedge now
+            # instead of waiting out the silence timeout
+            print(f"{self.identity}: learner epoch -> "
+                  f"{self.core.learner_epoch}, forgave {forgiven} "
+                  f"outstanding write-back(s)", flush=True)
+            self._last_wb = time.monotonic()
+            self._drain_inbox()
         batch = self.core.next_batch()
         if batch is None:
             reply = ("dry", {"ingested": self.core.ingested,
-                             "warm": self.core.warm})
+                             "warm": self.core.warm,
+                             "stale_wb": self.core.stale_wb,
+                             "restored": self.core.restored})
         else:
             obs_spans.stamp(batch, "batch_send")
             self.batches_served += 1
             reply = ("batch", batch)
+        if self._mute:
+            self.chaos_muted += 1       # the reply dies on the down link
+            return
         self.sock.send_multipart([ident, wire.dumps(reply)])
 
-    def _handle_prio(self, seq: int, idx, prios) -> None:
-        self.core.write_back(int(seq), idx, prios)
+    def _handle_prio(self, seq: int, idx, prios, epoch: int = 0) -> None:
+        stale_before = self.core.stale_wb
+        self.core.write_back(int(seq), idx, prios, epoch=int(epoch))
+        if self.core.stale_wb > stale_before:
+            return      # a dead learner's ghost is not liveness
         self._last_wb = time.monotonic()
         self._drain_inbox()
 
@@ -223,6 +256,7 @@ class ReplayShardServer:
                   f"write-back(s) after {self.comms.dead_after_s:.0f}s "
                   f"of learner silence", flush=True)
             self._drain_inbox()
+        self._maybe_snapshot()
         if not self.sock.poll(timeout_ms, self._zmq.POLLIN):
             return False
         ident, payload = self.sock.recv_multipart()
@@ -235,12 +269,35 @@ class ReplayShardServer:
         if kind == "chunk":
             self._handle_chunk(ident, msg[1])
         elif kind == "pull":
-            self._handle_pull(ident)
+            self._handle_pull(ident,
+                              int(msg[1]) if len(msg) > 1 else 0)
         elif kind == "prio":
-            self._handle_prio(msg[1], msg[2], msg[3])
+            self._handle_prio(msg[1], msg[2], msg[3],
+                              int(msg[4]) if len(msg) > 4 else 0)
         else:
             self.rejected += 1      # well-pickled garbage is still garbage
         return True
+
+    def _maybe_snapshot(self) -> None:
+        """Periodic durability tick: persist the shard at most every
+        ``snapshot_s`` seconds, and only at quiescent points (strict
+        mode) so the on-disk state is the lockstep state a restore
+        resumes.  A failed write is counted, never fatal — durability
+        must not kill a serving shard."""
+        if not self.snapshot_path or self.snapshot_s <= 0:
+            return
+        if time.monotonic() - self._last_snapshot < self.snapshot_s:
+            return
+        if not self.core.quiescent():
+            return
+        try:
+            self.core.save_snapshot(self.snapshot_path)
+            self.snapshots += 1
+        except Exception as e:
+            self.snapshot_errors += 1
+            print(f"{self.identity}: snapshot failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        self._last_snapshot = time.monotonic()
 
     def run(self, stop_event=None, max_seconds: float | None = None) -> dict:
         deadline = (None if max_seconds is None
@@ -258,6 +315,8 @@ class ReplayShardServer:
                 "batches_served": self.batches_served,
                 "rejected": self.rejected,
                 "chaos_dropped": self.chaos.dropped,
+                "chaos_muted": self.chaos_muted,
+                "snapshots": self.snapshots,
                 "inbox_deferred": len(self._inbox)}
 
     def close(self) -> None:
@@ -266,21 +325,53 @@ class ReplayShardServer:
             self._hb_sender.close(drain_s=0.0)
 
 
+def snapshot_path_for(snapshot_dir: str, shard_id: int) -> str:
+    """One canonical snapshot file per shard index — the respawned
+    process finds its predecessor's state without coordination."""
+    import os
+    return os.path.join(snapshot_dir, f"replay_shard_{shard_id}.msgpack")
+
+
 def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
                      stop_event=None, max_seconds: float | None = None,
-                     bind_ip: str = "*") -> dict:
+                     bind_ip: str = "*",
+                     snapshot_dir: str | None = None) -> dict:
     """The ``--role replay`` entry point: build the shard core from the
-    fleet config, serve until stopped.  Returns the final stats dict."""
+    fleet config, serve until stopped.  Returns the final stats dict.
+
+    With ``snapshot_dir`` set the shard restores the newest snapshot on
+    startup (a supervised respawn rejoins WARM instead of refilling from
+    live streams) and keeps snapshotting at the config cadence."""
+    import os
+
     from apex_tpu.obs.trace import get_ring, set_process_label
 
     set_process_label(f"replay-{shard_id}")
     get_ring()                      # arm the trace ring's dump triggers
     core = build_shard_core(cfg, shard_id, family=family)
-    server = ReplayShardServer(cfg.comms, shard_id, core)
+    snap_path = None
+    if snapshot_dir:
+        os.makedirs(snapshot_dir, exist_ok=True)
+        snap_path = snapshot_path_for(snapshot_dir, shard_id)
+        if os.path.exists(snap_path):
+            try:
+                core.restore_snapshot(snap_path)
+                print(f"replay-{shard_id}: warm restore "
+                      f"({core.ingested} transitions, "
+                      f"{core.sampled} batches sampled, learner epoch "
+                      f"{core.learner_epoch}) from {snap_path}",
+                      flush=True)
+            except Exception as e:
+                print(f"replay-{shard_id}: cold start — snapshot "
+                      f"unusable ({type(e).__name__}: {e})", flush=True)
+    server = ReplayShardServer(cfg.comms, shard_id, core,
+                               snapshot_path=snap_path)
     print(f"replay-{shard_id}: serving on port "
           f"{cfg.comms.replay_port_base + shard_id} "
           f"(capacity={cfg.replay.capacity}, warmup={core.warmup}/shard, "
-          f"strict={core.strict_order})", flush=True)
+          f"strict={core.strict_order}, "
+          f"snapshots={'on' if snap_path and server.snapshot_s > 0 else 'off'})",
+          flush=True)
     try:
         return server.run(stop_event=stop_event, max_seconds=max_seconds)
     finally:

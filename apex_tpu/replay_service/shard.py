@@ -97,6 +97,17 @@ class ReplayShardCore:
         self.sampled = 0                # batches ever sampled (chain length)
         self.wb_applied = 0             # write-backs applied
         self.dup_wb = 0                 # duplicate/late write-backs dropped
+        # learner-epoch fencing (PR 8): the highest epoch any pull or
+        # write-back has carried (0 = unstamped legacy traffic, fencing
+        # off).  Write-backs from an OLDER epoch are a restarted
+        # learner's predecessor talking — rejected and counted, never
+        # applied (they would corrupt priorities the new learner already
+        # owns); a NEWER epoch's first pull forgives the old epoch's
+        # outstanding batches (that learner is gone with its write-backs)
+        self.learner_epoch = 0
+        self.stale_wb = 0               # stale-epoch write-backs rejected
+        self.epoch_forgiven = 0         # batches forgiven on epoch bumps
+        self.restored = 0               # transitions resident at restore
         self._outbox: deque[dict] = deque()
         self._pending_spans: deque = deque(maxlen=MAX_BATCH_SPANS)
 
@@ -206,11 +217,34 @@ class ReplayShardCore:
 
     # -- write-back --------------------------------------------------------------
 
-    def write_back(self, seq: int, idx, priorities) -> bool:
+    def note_epoch(self, epoch: int) -> int:
+        """Pull-side half of the epoch fence: a pull stamped with a NEWER
+        learner epoch proves a restart — the old learner's outstanding
+        write-backs will never arrive, so they are forgiven immediately
+        (counted) instead of wedging the strict gate until the silence
+        timeout.  Returns the number forgiven."""
+        if epoch <= self.learner_epoch:
+            return 0
+        forgiven = 0
+        if self.learner_epoch and self.outstanding() > 0:
+            forgiven = self.forgive_outstanding()
+            self.epoch_forgiven += forgiven
+        self.learner_epoch = epoch
+        return forgiven
+
+    def write_back(self, seq: int, idx, priorities, epoch: int = 0) -> bool:
         """Apply one batch's TD priorities to the tree rows it was
         sampled from.  Duplicates (a retried pull training the same data
         twice) are counted and dropped — the zmq DEALER preserves order,
-        so ``seq`` regressions only mean retransmits."""
+        so ``seq`` regressions only mean retransmits.  A write-back
+        stamped with a STALE learner epoch (a restarted learner's
+        predecessor) is rejected and counted — applying it would corrupt
+        priorities on rows the new learner's stream now owns."""
+        if epoch and self.learner_epoch and epoch < self.learner_epoch:
+            self.stale_wb += 1
+            return False
+        if epoch > self.learner_epoch:
+            self.learner_epoch = epoch
         if seq < self.wb_applied:
             self.dup_wb += 1
             return False
@@ -231,6 +265,79 @@ class ReplayShardCore:
         self.wb_applied = self.sampled
         return n
 
+    # -- durability (PR 8: shard checkpoint/restore) -----------------------------
+
+    #: spec fields a snapshot pins — a restore into a differently-shaped
+    #: shard would corrupt silently, so mismatches start cold instead
+    _SNAP_PINS = ("batch_size", "warmup", "n_shards", "strict_order",
+                  "update_needs_key")
+
+    def quiescent(self) -> bool:
+        """True when a snapshot taken now is self-consistent: no batch in
+        flight to the learner and none pre-sampled but unserved (their
+        write-backs/serves would be lost with the process, breaking the
+        strict lockstep a restore resumes).  Loose mode snapshots
+        anywhere — restore forgives the in-flight tail."""
+        if not self.strict_order:
+            return True
+        return self.outstanding() == 0 and not self._outbox
+
+    def snapshot_meta(self) -> dict:
+        meta = {p: getattr(self, p) for p in self._SNAP_PINS}
+        meta.update(
+            capacity=self.replay.capacity,
+            ingested=self.ingested, chunks=self.chunks,
+            sampled=self.sampled, wb_applied=self.wb_applied,
+            dup_wb=self.dup_wb, stale_wb=self.stale_wb,
+            epoch_forgiven=self.epoch_forgiven,
+            learner_epoch=self.learner_epoch)
+        return meta
+
+    def save_snapshot(self, path: str) -> str:
+        """Atomically persist the whole shard — segment trees + frame
+        pool (one FramePoolState pytree), PRNG chain, counters — with the
+        same tmp+rename discipline as ``fleet_summary.json``.  A reader
+        never sees a torn file; a crash mid-save leaves the previous
+        snapshot restorable."""
+        from apex_tpu.training.checkpoint import save_bundle
+        return save_bundle(
+            path,
+            {"state": self.state, "key": jax.random.key_data(self.key)},
+            self.snapshot_meta())
+
+    def restore_snapshot(self, path: str) -> dict:
+        """Warm-rejoin from a snapshot: bit-exact replay state, key
+        chain, and counters.  Batches sampled-but-unresolved at snapshot
+        time (loose mode) are forgiven — their learner round-trips died
+        with the old process.  Raises ValueError on a spec mismatch (the
+        caller starts cold rather than corrupt)."""
+        from apex_tpu.training.checkpoint import restore_bundle
+        bundle, meta = restore_bundle(
+            path,
+            {"state": self.state, "key": jax.random.key_data(self.key)})
+        for pin in self._SNAP_PINS + ("capacity",):
+            want = (self.replay.capacity if pin == "capacity"
+                    else getattr(self, pin))
+            if meta.get(pin) != want:
+                raise ValueError(
+                    f"snapshot {pin}={meta.get(pin)!r} != live shard "
+                    f"{pin}={want!r} — refusing a shape-shifting restore")
+        self.state = bundle["state"]
+        self.key = jax.random.wrap_key_data(bundle["key"])
+        self.ingested = int(meta["ingested"])
+        self.chunks = int(meta["chunks"])
+        self.sampled = int(meta["sampled"])
+        self.dup_wb = int(meta["dup_wb"])
+        self.stale_wb = int(meta.get("stale_wb", 0))
+        self.epoch_forgiven = int(meta.get("epoch_forgiven", 0))
+        self.learner_epoch = int(meta.get("learner_epoch", 0))
+        # in-flight tail forgiven: late write-backs land as counted dups
+        self.wb_applied = self.sampled
+        self._outbox.clear()
+        self._pending_spans.clear()
+        self.restored = self.ingested
+        return meta
+
     # -- observability -----------------------------------------------------------
 
     def stats(self) -> dict:
@@ -240,6 +347,10 @@ class ReplayShardCore:
             "sampled": self.sampled,
             "wb_applied": self.wb_applied,
             "dup_wb": self.dup_wb,
+            "stale_wb": self.stale_wb,
+            "epoch_forgiven": self.epoch_forgiven,
+            "learner_epoch": self.learner_epoch,
+            "restored": self.restored,
             "outbox": len(self._outbox),
             "warm": self.warm,
         }

@@ -116,6 +116,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "they land) instead of the default strict "
                         "lockstep that is bit-identical to in-learner "
                         "replay at N=1")
+    p.add_argument("--replay-snapshot-dir",
+                   default=e.get("APEX_REPLAY_SNAPSHOT_DIR"),
+                   help="replay role: restore the newest shard snapshot "
+                        "from here on startup (warm respawn) and keep "
+                        "snapshotting at --replay-snapshot-every")
+    p.add_argument("--replay-snapshot-every", type=float,
+                   default=float(e.get("APEX_REPLAY_SNAPSHOT_S")
+                                 or c.replay_snapshot_s),
+                   help="seconds between shard snapshots (atomic "
+                        "write, quiescent points only); 0 = off")
     # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
     # and the registry/park state-machine windows — env twins so a whole
     # topology (tests, chaos drills) retunes them without flag plumbing
@@ -164,6 +174,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "exposition (scalars, rates, fleet, latency "
                         "histograms) instead of the fleet table — one "
                         "REQ round-trip to the learner's status server")
+    p.add_argument("--http", type=int,
+                   default=int(e.get("APEX_METRICS_HTTP", 0)),
+                   help="status role with --metrics: serve the "
+                        "exposition over plain HTTP on this port (GET "
+                        "/metrics proxies one zmq round-trip per "
+                        "scrape) so a stock Prometheus server can poll "
+                        "directly; 0 = one-shot print")
     p.add_argument("--trace-dir", default=e.get("APEX_TRACE_DIR"),
                    help="enable the per-role trace ring and dump Chrome "
                         "trace-event JSON here (atexit/periodic/SIGUSR2); "
@@ -239,7 +256,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                           replay_shards=args.replay_shards,
                           replay_port_base=args.replay_port_base,
                           replay_ip=args.replay_ip,
-                          replay_strict_order=not args.replay_loose),
+                          replay_strict_order=not args.replay_loose,
+                          replay_snapshot_s=args.replay_snapshot_every),
     )
 
 
@@ -311,12 +329,30 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
         from apex_tpu.runtime.roles import _with_ips
         cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
         run_replay_shard(cfg, args.shard_id, family=args.family,
-                         max_seconds=args.max_seconds)
+                         max_seconds=args.max_seconds,
+                         snapshot_dir=args.replay_snapshot_dir)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the
         # Prometheus text exposition for standard scrape tooling
         if args.metrics:
+            if args.http:
+                # plain-HTTP Prometheus sidecar: a stock Prometheus
+                # server polls GET /metrics; each scrape proxies one zmq
+                # REQ round-trip to the learner's status server
+                from apex_tpu.obs.metrics import make_http_sidecar
+                server = make_http_sidecar(cfg.comms, port=args.http,
+                                           learner_ip=args.learner_ip)
+                print(f"metrics sidecar: http://0.0.0.0:{args.http}"
+                      f"/metrics -> zmq {args.learner_ip}:"
+                      f"{cfg.comms.status_port}", flush=True)
+                try:
+                    server.serve_forever()
+                except KeyboardInterrupt:
+                    pass
+                finally:
+                    server.server_close()
+                return 0
             from apex_tpu.obs.metrics import metrics_request
             text = metrics_request(cfg.comms, learner_ip=args.learner_ip)
             if text is None:

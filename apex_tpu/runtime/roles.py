@@ -112,17 +112,24 @@ class _ChunkQueueAdapter:
         while not self.stop_event.is_set():
             if self.sender.send_chunk(msg, self.stop_event, max_wait_s=1.0):
                 return
-            # no credit for a full second: dead learner, or just slow?
-            # park_and_rejoin probes the param stream and only parks when
-            # it is stale too (the rejoin stashes fresh params for the
-            # param adapter's next poll and resets the credit window so
-            # this chunk can re-send)
+            # no credit for a full second: dead learner, withheld acks,
+            # or just slow?  Count the retry (the chunk never hit the
+            # wire — retrying is lossless), then park_and_rejoin probes
+            # the param stream and only parks when it is stale too (the
+            # rejoin stashes fresh params for the param adapter's next
+            # poll and resets the credit window so this chunk can
+            # re-send)
+            note = getattr(self.sender, "note_resend", None)
+            if note is not None:
+                note()
             self.park.park_and_rejoin()
 
     def wire_counters(self) -> dict:
         """HeartbeatEmitter ``counters_fn`` hook."""
         return {"chunks_sent": self.sender.chunks_sent,
-                "acks_received": self.sender.acks_received}
+                "acks_received": self.sender.acks_received,
+                "resends": getattr(self.sender, "resends", 0),
+                "rerouted": getattr(self.sender, "rerouted", 0)}
 
 
 class _StatQueueAdapter:

@@ -60,15 +60,25 @@ def _ctx() -> zmq.Context:
 
 class ParamPublisher:
     """Learner-side PUB socket (``learner.py:57-68``): send-and-forget with
-    a small HWM; slow subscribers see only the latest version."""
+    a small HWM; slow subscribers see only the latest version.
+
+    ``epoch`` (learner-epoch fencing, PR 8): when set nonzero, every
+    publish carries the learner's monotonically-bumped epoch as a third
+    tuple element so parked actors can distinguish a RESTARTED learner
+    (epoch changed: the outstanding ack window died with it, reset) from
+    a merely STALLED one (same epoch: the acks are still coming).  Zero
+    keeps the legacy 2-tuple wire format."""
 
     def __init__(self, comms: CommsConfig, bind_ip: str = "*"):
         self.sock = _ctx().socket(zmq.PUB)
         self.sock.setsockopt(zmq.SNDHWM, comms.param_hwm)
         self.sock.bind(f"tcp://{bind_ip}:{comms.param_port}")
+        self.epoch = 0
 
     def publish(self, version: int, params) -> None:
-        self.sock.send(pickle.dumps((version, params), protocol=5))
+        msg = ((version, params, self.epoch) if self.epoch
+               else (version, params))
+        self.sock.send(pickle.dumps(msg, protocol=5))
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -86,14 +96,24 @@ class ParamSubscriber:
         ip = learner_ip or comms.learner_ip
         self.sock.connect(f"tcp://{ip}:{comms.param_port}")
         self.rejected = 0           # payloads outside the wire allowlist
+        # learner-epoch of the newest stamped publish (0 until one lands);
+        # the ParkController reads this to tell restart from stall
+        self.learner_epoch = 0
 
     def poll(self, timeout_ms: int = 0):
-        """Newest ``(version, params)`` or None."""
+        """Newest ``(version, params)`` or None.  Epoch-stamped publishes
+        (3-tuples) update :attr:`learner_epoch` and still return the
+        2-tuple every consumer expects."""
         if self.sock.poll(timeout_ms, zmq.POLLIN):
             try:
-                return wire.restricted_loads(self.sock.recv())
+                got = wire.restricted_loads(self.sock.recv())
             except wire.WireRejected:
                 self.rejected += 1      # one bad publish costs one poll
+                return None
+            if isinstance(got, tuple) and len(got) == 3:
+                self.learner_epoch = int(got[2])
+                return got[:2]
+            return got
         return None
 
     def wait_first(self, stop_event=None, timeout_ms: int = 500):
@@ -129,9 +149,18 @@ class ChunkSender:
         self.max_outstanding = comms.max_outstanding_sends
         self._in_flight = 0
         # fleet observability: cumulative wire counters (shipped in
-        # Heartbeats so the learner's registry can difference them)
+        # Heartbeats so the learner's registry can difference them).
+        # ``resends`` counts bounded-wait send attempts that found no
+        # credit and were retried by the caller — the visible trace of an
+        # ack-withholding fault riding out without chunk loss.
         self.chunks_sent = 0
         self.acks_received = 0
+        self.resends = 0
+
+    def note_resend(self) -> None:
+        """The caller's retry loop re-attempted a send that timed out on
+        credit (the chunk was never on the wire, so nothing is lost)."""
+        self.resends += 1
 
     def _drain_acks(self, timeout_ms: int) -> None:
         while self.sock.poll(timeout_ms, zmq.POLLIN):
@@ -244,6 +273,19 @@ class ChunkReceiver:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self.rejected = 0          # payloads outside the wire allowlist
+        # learner-side ingress chaos (apex_tpu/fleet/chaos, identity
+        # "learner"): ack withholding parks the acks of a scheduled chunk
+        # window for hold_s before releasing them, exhausting sender
+        # credit windows so their bounded-retry recovery is exercised —
+        # acks are DELAYED, never dropped, so no chunk is ever lost
+        from apex_tpu.fleet.chaos import chaos_from_env
+        chaos = chaos_from_env()
+        self._chaos = (chaos.plan_for("learner")
+                       if chaos is not None else None)
+        self._ack_count = 0            # chunks acked or withheld so far
+        self._withheld: list = []      # (release_monotonic, ident)
+        self._withhold_lock = threading.Lock()
+        self.acks_withheld = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._decoders = [
             threading.Thread(target=self._decode_loop, daemon=True)
@@ -255,12 +297,36 @@ class ChunkReceiver:
             d.start()
 
     def _send_pending_acks(self) -> None:
+        if self._withheld:
+            now = time.monotonic()
+            with self._withhold_lock:
+                due = [i for t, i in self._withheld if t <= now]
+                self._withheld = [(t, i) for t, i in self._withheld
+                                  if t > now]
+            for ident in due:          # the fault DELAYS acks, never
+                self.sock.send_multipart([ident, b"ack"])   # drops them
         try:
             while True:
                 ident = self._ack_q.get_nowait()
                 self.sock.send_multipart([ident, b"ack"])
         except queue_lib.Empty:
             pass
+
+    def _enqueue_ack(self, ident: bytes) -> None:
+        """Decoder-side ack routing: scheduled ack-withhold windows park
+        the ack until its release time; everything else acks normally."""
+        plan = self._chaos
+        if plan is not None and plan.ack_withhold_at is not None:
+            with self._withhold_lock:
+                i = self._ack_count
+                self._ack_count += 1
+                if (plan.ack_withhold_at <= i
+                        < plan.ack_withhold_at + plan.ack_withhold_n):
+                    self.acks_withheld += 1
+                    self._withheld.append(
+                        (time.monotonic() + plan.ack_withhold_s, ident))
+                    return
+        self._ack_q.put(ident)
 
     def _run(self) -> None:
         """Socket thread: the only thread touching the ROUTER (zmq sockets
@@ -310,7 +376,7 @@ class ChunkReceiver:
                     while not self._stop.is_set():
                         try:
                             self.chunks.put(body, timeout=0.1)
-                            self._ack_q.put(ident)
+                            self._enqueue_ack(ident)
                             break
                         except queue_lib.Full:
                             continue
@@ -396,6 +462,52 @@ def barrier_wait(comms: CommsConfig, identity: str,
         sock.close(linger=0)
 
 
+class RejoinBarrier:
+    """The startup barrier, RE-RUN as a standing service (PR 8 registry
+    reactions): after the one-shot all-or-nothing release
+    (:func:`barrier_release`), the learner keeps a ROUTER on the barrier
+    port whose thread answers EVERY hello with an immediate ``go`` — so
+    late capacity (a scale-up actor that missed fleet start) and
+    supervisor-respawned peers re-admit in one round-trip instead of
+    waiting out the barrier timeout for the param-stream fallback.
+    ``admitted`` counts re-admissions (surfaced in fleet_summary.json)."""
+
+    def __init__(self, comms: CommsConfig, bind_ip: str = "*"):
+        self.sock = _ctx().socket(zmq.ROUTER)
+        # the one-shot release just closed this port in-process; give the
+        # rebind a breath instead of dying on a transient EADDRINUSE
+        deadline = time.monotonic() + 2.0
+        while True:
+            try:
+                self.sock.bind(f"tcp://{bind_ip}:{comms.barrier_port}")
+                break
+            except zmq.ZMQError:
+                if time.monotonic() > deadline:
+                    self.sock.close(linger=0)
+                    raise
+                time.sleep(0.05)
+        self.admitted = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.sock.poll(200, zmq.POLLIN):
+                continue
+            ident, _empty, _hello = self.sock.recv_multipart()
+            self.sock.send_multipart([ident, b"", b"go"])
+            self.admitted += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5)
+        self.sock.close(linger=0)
+
+
 @dataclass
 class RemotePool:
     """Socket-backed drop-in for :class:`apex_tpu.actors.pool.ActorPool` —
@@ -433,6 +545,7 @@ class RemotePool:
         self.receiver = ChunkReceiver(self.comms,
                                       queue_depth=self.queue_depth)
         self.publisher: ParamPublisher | None = None
+        self.rejoin_barrier: RejoinBarrier | None = None
         self.procs: list = []           # interface parity (nothing local)
 
     def start(self) -> None:
@@ -449,9 +562,37 @@ class RemotePool:
             self.cleanup()
             raise TimeoutError(
                 f"startup barrier: {released}/{self.n_peers} peers")
+        try:
+            # the barrier re-runs as a standing service from here on:
+            # respawned/late peers admit in one round-trip (losing it is
+            # a degradation — the param-stream rejoin race still works —
+            # never a dead learner)
+            self.rejoin_barrier = RejoinBarrier(self.comms)
+            self.rejoin_barrier.start()
+        except Exception:
+            self.rejoin_barrier = None
+
+    def set_learner_epoch(self, epoch: int) -> None:
+        """Stamp every subsequent publish with the learner's epoch
+        (learner-epoch fencing; tolerates the chaos publisher wrapper)."""
+        pub = self.publisher
+        if pub is None:
+            return
+        getattr(pub, "inner", pub).epoch = int(epoch)
+
+    def rejoin_admitted(self) -> int:
+        rb = self.rejoin_barrier
+        return rb.admitted if rb is not None else 0
+
+    def acks_withheld(self) -> int:
+        """Chaos-withheld acks since start (ack-withholding drills)."""
+        return self.receiver.acks_withheld
 
     def cleanup(self) -> None:
         self.receiver.stop()
+        if self.rejoin_barrier is not None:
+            self.rejoin_barrier.stop()
+            self.rejoin_barrier = None
         if self.publisher is not None:
             self.publisher.close()
 

@@ -132,6 +132,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
     replay_client = None
     _train_batch = None
     service_steps = 0        # train steps taken on shard-served batches
+    # learner-epoch fencing (PR 8): bumped once per learner LIFE (restore
+    # reads the predecessor's epoch from checkpoint meta and adds one),
+    # stamped onto every param publish and replay write-back so parked
+    # actors can tell a restarted learner from a stalled one and shards
+    # can reject a dead learner's ghost write-backs
+    learner_epoch = 1
+    # registry reactions (PR 8): when >= relax_floor_dead_frac of the
+    # actor fleet is DEAD, the replay-ratio floor relaxes (the surviving
+    # actors must not be starved by a throughput target sized for the
+    # full fleet) and restores as peers rejoin
+    _floor_relaxed = False
+    floor_relaxes = 0        # times the floor reaction engaged
 
     # -- param plane -------------------------------------------------------
 
@@ -269,6 +281,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
         except BaseException:
             self._pipeline = None      # never started; don't route to it
             raise
+        # learner-epoch fencing: stamp the param plane and the replay
+        # write-back plane with this life's epoch (socket pools only —
+        # in-host fleets die with the learner, nothing to fence)
+        set_epoch = getattr(pool, "set_learner_epoch", None)
+        if set_epoch is not None:
+            set_epoch(self.learner_epoch)
+        if client is not None:
+            client.learner_epoch = self.learner_epoch
         if hasattr(pool, "peer_seen") and self._fleet_status is None:
             # socket learner: serve live registry snapshots for
             # `--role status` (own REP socket + thread; a bind failure —
@@ -277,7 +297,8 @@ class ConcurrentTrainer(CheckpointableTrainer):
             try:
                 from apex_tpu.fleet.registry import FleetStatusServer
                 self._fleet_status = FleetStatusServer(
-                    cfg.comms, self.fleet, metrics_fn=self._metrics_text)
+                    cfg.comms, self.fleet, metrics_fn=self._metrics_text,
+                    snapshot_fn=self.fleet_summary)
                 self._fleet_status.start()
             except Exception:
                 self._fleet_status = None
@@ -319,9 +340,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
                           else ingested_eff * self.train_ratio
                           / self.core.batch_size)
                 # Replay-ratio floor: learner behind -> pause draining so the
-                # bounded chunk queue backpressures the actor fleet.
-                behind = (warm and self.min_train_ratio is not None
-                          and consumed < ingested_eff * self.min_train_ratio)
+                # bounded chunk queue backpressures the actor fleet.  The
+                # EFFECTIVE floor is None while the dead-fleet reaction
+                # has relaxed it (see _react_to_fleet).
+                floor = self._min_ratio_effective()
+                behind = (warm and floor is not None
+                          and consumed < ingested_eff * floor)
 
                 got_data = False
                 if pipeline is not None:
@@ -449,6 +473,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
                              "fleet_dead": fm["dead"],
                              "fleet_parked": fm["parked"],
                              "fleet_rejoins": fm["rejoins"]}, steps)
+                    self._react_to_fleet(steps)
                     self._dump_fleet_summary()
                     last_health = now
 
@@ -597,6 +622,22 @@ class ConcurrentTrainer(CheckpointableTrainer):
         rejected = getattr(self.pool, "wire_rejected", None)
         snap["metrics"]["wire_rejected"] = (rejected()
                                             if callable(rejected) else 0)
+        m = snap["metrics"]
+        # elastic-fleet surface (PR 8): epoch, reaction state, the
+        # backpressure signal scale supervisors key off, re-admissions,
+        # and the chaos receiver's withheld-ack count
+        m["learner_epoch"] = self.learner_epoch
+        m["floor_relaxed"] = self._floor_relaxed
+        m["floor_relaxes"] = self.floor_relaxes
+        m["dead_actor_frac"] = round(
+            self.fleet.dead_fraction(roles=("actor",)), 4)
+        plane = self.actor_plane()
+        m["actor_drain_frac"] = (plane["drain_frac"]
+                                 if plane is not None else None)
+        admitted = getattr(self.pool, "rejoin_admitted", None)
+        m["barrier_admitted"] = (admitted() if callable(admitted) else 0)
+        withheld = getattr(self.pool, "acks_withheld", None)
+        m["acks_withheld"] = (withheld() if callable(withheld) else 0)
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {
@@ -633,6 +674,41 @@ class ConcurrentTrainer(CheckpointableTrainer):
             os.replace(tmp, path)      # readers never see a torn write
         except OSError:
             pass                       # observability must not kill a run
+
+    # -- registry reactions (PR 8) -----------------------------------------
+
+    def _min_ratio_effective(self) -> float | None:
+        """The replay-ratio floor the loop actually enforces: the
+        configured ``min_train_ratio``, or None while the dead-fleet
+        reaction has relaxed it."""
+        return None if self._floor_relaxed else self.min_train_ratio
+
+    def _react_to_fleet(self, steps: int) -> None:
+        """Close the registry loop: when the DEAD fraction of the actor
+        fleet reaches the config threshold, relax the replay-ratio floor
+        (survivors must not be throttled against a throughput target the
+        dead capacity was part of); restore it as peers rejoin.  The
+        reaction is hysteresis-free on purpose — the registry's own
+        SUSPECT window already debounces flapping peers."""
+        thresh = getattr(self.cfg.comms, "relax_floor_dead_frac", None)
+        if (thresh is None or self.fleet is None
+                or self.min_train_ratio is None):
+            return
+        frac = self.fleet.dead_fraction(roles=("actor",))
+        if not self._floor_relaxed and frac >= thresh:
+            self._floor_relaxed = True
+            self.floor_relaxes += 1
+            print(f"fleet reaction: {frac:.0%} of actor capacity DEAD — "
+                  f"relaxing the replay-ratio floor "
+                  f"(min_train_ratio={self.min_train_ratio})", flush=True)
+        elif self._floor_relaxed and frac < thresh:
+            self._floor_relaxed = False
+            print(f"fleet reaction: actor capacity back "
+                  f"({frac:.0%} DEAD) — replay-ratio floor restored",
+                  flush=True)
+        self.log.scalars({"fleet_dead_actor_frac": frac,
+                          "fleet_floor_relaxed":
+                              float(self._floor_relaxed)}, steps)
 
     def _beta(self, ingested: int | None = None) -> float:
         n = self.ingested if ingested is None else ingested
@@ -688,10 +764,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
         # the local pool, which only the local stream fills
         client_tot = client.ingested_total() if client is not None else 0
         consumed = self.steps_rate.total * self.core.batch_size
+        floor = self._min_ratio_effective()
         behind = (self.ingested >= cfg.replay.warmup
-                  and self.min_train_ratio is not None
-                  and consumed < (self.ingested + client_tot)
-                  * self.min_train_ratio)
+                  and floor is not None
+                  and consumed < (self.ingested + client_tot) * floor)
         # the step counter the chunk will MEET includes the train steps
         # already staged ahead of it — without them every chunk queued
         # behind one pending fused step looks budget-eligible and the
@@ -925,12 +1001,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
 
     def _counters(self) -> dict:
         return dict(ingested=self.ingested, steps=self.steps_rate.total,
-                    param_version=self.param_version)
+                    param_version=self.param_version,
+                    learner_epoch=self.learner_epoch)
 
     def _apply_counters(self, meta: dict) -> None:
         self.ingested = meta["ingested"]
         self.steps_rate.total = meta["steps"]
         self.param_version = meta["param_version"]
+        # epoch fencing: restoring from a checkpoint IS a new learner
+        # life — bump past the saved epoch so parked actors and replay
+        # shards see the restart (pre-fencing checkpoints restore as
+        # epoch 2: their writer was life 1 by definition)
+        self.learner_epoch = int(meta.get("learner_epoch", 1)) + 1
         # a restored trainer does not owe an immediate save/log: its marks
         # continue from the restored step count
         self._last_save = self._last_log = meta["steps"]

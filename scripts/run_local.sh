@@ -57,10 +57,25 @@ trap cleanup EXIT
 if [ "$REPLAY_SHARDS" -gt 0 ]; then
   # shard s binds replay_port_base + s; shards skip the startup barrier
   # (useful the moment the ROUTER binds), so launch them first and the
-  # actor fleet's first sealed chunks route straight to them
+  # actor fleet's first sealed chunks route straight to them.
+  #
+  # Durability (PR 8): APEX_REPLAY_SNAPSHOT_DIR (+ _S cadence) makes each
+  # shard snapshot its whole replay state and restore it on respawn;
+  # APEX_SUPERVISE_REPLAY=1 wraps each shard in the host supervisor so a
+  # chaos-killed shard respawns automatically and rejoins WARM from its
+  # snapshot (the chaos kill disarms on the supervised life).
+  export APEX_REPLAY_SNAPSHOT_DIR="${APEX_REPLAY_SNAPSHOT_DIR:-}"
+  export APEX_REPLAY_SNAPSHOT_S="${APEX_REPLAY_SNAPSHOT_S:-}"
   for s in $(seq 0 $((REPLAY_SHARDS - 1))); do
-    python -m apex_tpu.runtime --role replay --shard-id "$s" \
-      "${COMMON[@]}" &
+    if [ "${APEX_SUPERVISE_REPLAY:-0}" = "1" ]; then
+      python -m apex_tpu.fleet.supervise --min-uptime 1 \
+        --backoff 0.5 --backoff-max 2 -- \
+        python -m apex_tpu.runtime --role replay --shard-id "$s" \
+        "${COMMON[@]}" &
+    else
+      python -m apex_tpu.runtime --role replay --shard-id "$s" \
+        "${COMMON[@]}" &
+    fi
     pids+=($!)
   done
 fi

@@ -1007,6 +1007,47 @@ def test_j011_silent_on_specs_outside_annotation_surfaces():
         """, "J011")
 
 
+# -- J012: cross-process port collisions in one topology ---------------------
+
+def test_j012_fires_on_duplicate_ports_in_one_config_call():
+    assert fires("""
+        from apex_tpu.config import CommsConfig
+        comms = CommsConfig(batch_port=51001, param_port=51001)
+        """, "J012")
+
+
+def test_j012_fires_on_duplicate_port_defaults_in_a_config_class():
+    assert fires("""
+        from dataclasses import dataclass
+        @dataclass(frozen=True)
+        class MyComms:
+            batch_port: int = 51001
+            prios_port: int = 51002
+            replay_port_base: int = 51001
+        """, "J012")
+
+
+def test_j012_silent_on_distinct_ports_and_nonport_duplicates():
+    # distinct ports are the healthy topology; equal NON-port ints (hwm,
+    # window sizes) are not a collision
+    assert not fires("""
+        from apex_tpu.config import CommsConfig
+        comms = CommsConfig(batch_port=51001, param_port=52001,
+                            param_hwm=3, max_outstanding_sends=3)
+        """, "J012")
+
+
+def test_j012_silent_on_variable_and_zero_ports():
+    # test fixtures bind ephemeral ports through variables, and 0 means
+    # disabled/ephemeral — neither is a literal topology
+    assert not fires("""
+        from apex_tpu.config import CommsConfig
+        batch, param = free_ports(2)
+        a = CommsConfig(batch_port=batch, param_port=param)
+        b = CommsConfig(batch_port=0, param_port=0)
+        """, "J012")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

@@ -588,6 +588,47 @@ def test_supervisor_cli_subprocess_end_to_end():
     assert "crash loop" in p.stdout
 
 
+def test_supervisor_sigterm_terminates_child():
+    """Killing a supervisor must take its child with it (PR 8 fix): the
+    un-forwarded child used to survive as an orphan still bound to its
+    role's ports, shadowing the next fleet on the same host."""
+    import signal
+    import subprocess
+    import sys
+
+    marker = "apex_supervise_child_marker"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "apex_tpu.fleet.supervise", "--",
+         sys.executable, "-c",
+         f"import time; {marker} = 1; time.sleep(120)"])
+    try:
+        deadline = time.monotonic() + 30
+        child_pid = None
+        while child_pid is None and time.monotonic() < deadline:
+            probe = subprocess.run(["pgrep", "-f", marker],
+                                   capture_output=True, text=True)
+            pids = [int(x) for x in probe.stdout.split()
+                    if int(x) != p.pid]
+            child_pid = pids[0] if pids else None
+            time.sleep(0.1)
+        assert child_pid is not None, "child never came up"
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=30) != 0
+        import os
+        deadline = time.monotonic() + 10
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                os.kill(child_pid, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                gone = True
+        assert gone, "supervised child survived its supervisor"
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
 def test_supervisor_cli_rejects_missing_command():
     import subprocess
     import sys
@@ -595,6 +636,438 @@ def test_supervisor_cli_rejects_missing_command():
     p = subprocess.run([sys.executable, "-m", "apex_tpu.fleet.supervise"],
                        capture_output=True, text=True, timeout=60)
     assert p.returncode == 2
+
+
+# -- learner-epoch fencing on the param plane (PR 8) ------------------------
+
+def test_param_plane_carries_learner_epoch():
+    """An epoch-stamped publish updates the subscriber's learner_epoch
+    while every consumer still sees the plain (version, params) tuple;
+    unstamped (legacy) publishes leave the epoch untouched."""
+    from apex_tpu.runtime.transport import ParamPublisher, ParamSubscriber
+
+    comms = _comms()
+    sub = ParamSubscriber(comms)
+    pub = ParamPublisher(comms)
+    try:
+        time.sleep(0.2)                        # SUB connect (slow joiner)
+
+        def publish_until_seen(version):
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                pub.publish(version, {"w": version})
+                got = sub.poll(100)
+                if got is not None and got[0] == version:
+                    return got
+            raise AssertionError("publish never arrived")
+
+        got = publish_until_seen(1)            # unstamped: legacy 2-tuple
+        assert got == (1, {"w": 1})
+        assert sub.learner_epoch == 0
+
+        pub.epoch = 7                          # stamped: 3-tuple on the wire
+        got = publish_until_seen(2)
+        assert got == (2, {"w": 2})
+        assert sub.learner_epoch == 7
+    finally:
+        pub.close()
+        sub.close()
+
+
+class _EpochSub:
+    """Scripted param stream with an epoch stamp, for the park decision
+    table (no sockets: the barrier is monkeypatched).  ``delay_polls``
+    makes the first probes miss, so the controller genuinely parks
+    before the stream resumes."""
+
+    def __init__(self, delay_polls: int = 1):
+        self.learner_epoch = 0
+        self.queue: list = []
+        self.delay_polls = delay_polls
+
+    def poll(self, timeout_ms: int = 0):
+        if self.delay_polls > 0:
+            self.delay_polls -= 1
+            return None
+        if self.queue:
+            version, params, epoch = self.queue.pop(0)
+            self.learner_epoch = epoch
+            return (version, params)
+        return None
+
+
+@pytest.mark.parametrize("resume_epoch,expect_reset", [
+    (1, False),      # same epoch: the learner STALLED — acks still coming
+    (2, True),       # bumped epoch: a RESTART took the ack window with it
+])
+def test_park_decision_table_restart_vs_stall(monkeypatch, resume_epoch,
+                                              expect_reset):
+    monkeypatch.setattr("apex_tpu.runtime.transport.barrier_wait",
+                        lambda *a, **kw: True)
+    comms = CommsConfig(park_after_s=0.0)      # instantly stale
+    stop = threading.Event()
+    sub = _EpochSub()
+    sub.learner_epoch = 1                      # epoch seen before the park
+    sender = _StubSender()
+    sender.resets = 0
+    sender.reset_credits = lambda: setattr(
+        sender, "resets", sender.resets + 1)
+    park = ParkController(comms, "actor-0", stop, sub=sub, sender=sender,
+                          sleep=lambda s: None)
+    park._last_params = -1e9                   # long stale
+    sub.queue.append((9, {"w": 9}, resume_epoch))
+    got = park.park_and_rejoin()
+    assert got == (9, {"w": 9})
+    assert park.rejoins == 1
+    assert sender.resets == (1 if expect_reset else 0)
+    if expect_reset:
+        assert park.restarts_seen == 1 and park.stall_resumes == 0
+    else:
+        assert park.stall_resumes == 1 and park.restarts_seen == 0
+
+
+def test_park_unstamped_stream_keeps_legacy_reset():
+    """A pre-fencing learner (no epoch stamps) must keep today's
+    conservative behavior: every rejoin resets the credit window."""
+    import unittest.mock as mock
+
+    with mock.patch("apex_tpu.runtime.transport.barrier_wait",
+                    return_value=True):
+        comms = CommsConfig(park_after_s=0.0)
+        stop = threading.Event()
+        sub = _EpochSub()                      # epoch stays 0
+        sender = _StubSender()
+        sender.resets = 0
+        sender.reset_credits = lambda: setattr(
+            sender, "resets", sender.resets + 1)
+        park = ParkController(comms, "actor-0", stop, sub=sub,
+                              sender=sender, sleep=lambda s: None)
+        park._last_params = -1e9
+        sub.queue.append((3, {"w": 3}, 0))
+        assert park.park_and_rejoin() == (3, {"w": 3})
+        assert sender.resets == 1
+
+
+# -- registry reactions (PR 8) -----------------------------------------------
+
+def test_registry_dead_fraction_counts_roles_separately():
+    t = [0.0]
+    comms = CommsConfig(suspect_after_s=2.0, dead_after_s=5.0)
+    reg = FleetRegistry(comms, clock=lambda: t[0])
+    reg.observe(Heartbeat("actor-0", role="actor"))
+    reg.observe(Heartbeat("actor-1", role="actor"))
+    reg.observe(Heartbeat("replay-0", role="replay"))
+    assert reg.dead_fraction() == 0.0
+    t[0] = 20.0
+    reg.tick()                                  # everyone DEAD
+    reg.observe(Heartbeat("actor-1", role="actor"))   # one actor back
+    assert reg.dead_fraction() == pytest.approx(0.5)
+    assert reg.dead_fraction(roles=("replay",)) == 1.0
+    assert reg.dead_fraction(roles=("evaluator",)) == 0.0   # none seen
+
+
+def test_rejoin_barrier_admits_late_peers():
+    from apex_tpu.runtime import transport
+
+    comms = _comms()
+    rb = transport.RejoinBarrier(comms)
+    rb.start()
+    try:
+        assert transport.barrier_wait(comms, "late-actor", timeout_s=10)
+        assert transport.barrier_wait(comms, "respawned-actor",
+                                      timeout_s=10)
+        deadline = time.monotonic() + 5
+        while rb.admitted < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rb.admitted == 2
+    finally:
+        rb.stop()
+
+
+def test_heartbeat_resend_and_reroute_counters_reach_snapshot():
+    reg = FleetRegistry(CommsConfig())
+    reg.observe(Heartbeat("actor-0", role="actor", resends=4, rerouted=2))
+    peer = reg.snapshot()["peers"][0]
+    assert peer["resends"] == 4 and peer["rerouted"] == 2
+
+
+# -- ack withholding (learner ingress fault) ---------------------------------
+
+def test_ack_withholding_delays_acks_but_loses_no_chunk(monkeypatch):
+    """The seeded ingress fault: acks for a scheduled chunk window park
+    for hold_s, the sender's credit window exhausts (bounded sends fail
+    and are RETRIED — counted as resends), then the withheld acks
+    release and everything recovers with zero chunk loss."""
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    monkeypatch.setenv("CHAOS_SEED", "5")
+    monkeypatch.setenv(
+        "CHAOS_SPEC",
+        '{"ack_withhold": {"at": 0, "n": 2, "hold_s": 1.0}}')
+    comms = _comms(max_outstanding_sends=2)
+    recv = ChunkReceiver(comms, queue_depth=8, n_decoders=1)
+    recv.start()
+    sender = ChunkSender(comms, "actor-0")
+    try:
+        assert sender.send_chunk({"i": 0})
+        assert sender.send_chunk({"i": 1})     # window now full, acks parked
+        deadline = time.monotonic() + 10
+        while recv.acks_withheld < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert recv.acks_withheld == 2
+        # no credit: the bounded send fails and the caller retries
+        assert not sender.send_chunk({"i": 2}, max_wait_s=0.2)
+        sender.note_resend()
+        ok = False
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:     # hold_s elapses mid-loop
+            if sender.send_chunk({"i": 2}, max_wait_s=0.5):
+                ok = True
+                break
+            sender.note_resend()
+        assert ok, "withheld acks never released"
+        got = [recv.chunks.get(timeout=5) for _ in range(3)]
+        assert [g["i"] for g in got] == [0, 1, 2]   # delayed, never lost
+        assert sender.resends >= 1
+        # every chunk eventually acked — the window fully recovered
+        deadline = time.monotonic() + 10
+        while sender.acks_received < 3 and time.monotonic() < deadline:
+            sender._drain_acks(50)
+        assert sender.acks_received == 3
+    finally:
+        sender.close(drain_s=0)
+        recv.stop()
+
+
+# -- elastic scale supervision (PR 8) ----------------------------------------
+
+def test_scale_decision_table():
+    from apex_tpu.fleet.supervise import scale_decision
+
+    assert scale_decision(0.9, 4, 1, 8) == 3    # drain-bound: retire one
+    assert scale_decision(0.05, 4, 1, 8) == 5   # learner starving: add one
+    assert scale_decision(0.3, 4, 1, 8) == 4    # healthy band: hold
+    assert scale_decision(None, 4, 1, 8) == 4   # unreadable signal: hold
+    assert scale_decision(0.9, 1, 1, 8) == 1    # clamped at the floor
+    assert scale_decision(0.0, 8, 1, 8) == 8    # clamped at the ceiling
+
+
+class _FakeChild:
+    def __init__(self, cmd, env):
+        self.cmd, self.env = cmd, env
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = -15
+
+
+def test_scale_supervisor_spawns_substitutes_and_scales():
+    from apex_tpu.fleet.supervise import ScaleSupervisor
+
+    spawned: list[_FakeChild] = []
+
+    def spawn(cmd, env):
+        child = _FakeChild(cmd, env)
+        spawned.append(child)
+        return child
+
+    probes = [0.05, 0.9]                        # starving, then drain-bound
+    sup = ScaleSupervisor(["run", "--actor-id", "{slot}"], n_min=2,
+                          n_max=4, probe=lambda: probes.pop(0),
+                          spawn=spawn)
+    sup._apply_target()
+    assert sorted(sup.children) == [0, 1]
+    assert spawned[0].cmd == ["run", "--actor-id", "0"]
+    assert spawned[1].cmd == ["run", "--actor-id", "1"]
+    assert spawned[0].env["APEX_RESPAWN_COUNT"] == "0"
+
+    sup.tick()                                  # 0.05 -> scale up to 3
+    assert sup.target == 3 and sorted(sup.children) == [0, 1, 2]
+    assert sup.scale_ups == 1
+
+    sup.children[1].rc = 137                    # a chaos kill: respawn
+    sup.tick()                                  # 0.9 -> scale down to 2
+    assert sup.target == 2 and sorted(sup.children) == [0, 1]
+    assert sup.scale_downs == 1
+    respawned = [c for c in spawned if c.cmd == ["run", "--actor-id", "1"]]
+    assert len(respawned) == 2                  # original + one respawn
+    assert respawned[1].env["APEX_RESPAWN_COUNT"] == "1"
+    highest = [c for c in spawned if c.cmd == ["run", "--actor-id", "2"]]
+    assert highest[0].terminated                # scale-down retires slot 2
+
+
+def test_fleet_drain_frac_probe_reads_trainer_summary():
+    """The scale supervisor's backpressure probe: one status round-trip
+    to a server whose snapshot_fn is the trainer's fleet summary."""
+    from apex_tpu.fleet.supervise import fleet_drain_frac
+
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    srv = FleetStatusServer(
+        comms, reg,
+        snapshot_fn=lambda: {"peers": [],
+                             "metrics": {"actor_drain_frac": 0.42}})
+    srv.start()
+    try:
+        got = fleet_drain_frac(learner_ip="127.0.0.1",
+                               status_port=comms.status_port)
+        assert got == pytest.approx(0.42)
+    finally:
+        srv.stop()
+
+
+class _NullPool:
+    """Interface-complete pool stub for trainer-level reaction tests."""
+
+    procs: list = []
+
+    def start(self):
+        pass
+
+    def cleanup(self):
+        pass
+
+    def poll_chunks(self, n, timeout=0.0):
+        return []
+
+    def poll_stats(self):
+        return []
+
+    def publish_params(self, version, params):
+        pass
+
+
+def test_learner_relaxes_and_restores_floor_on_dead_actor_capacity():
+    """The registry-reaction loop closed (tentpole leg 1): with half the
+    actor fleet DEAD the replay-ratio floor relaxes (the effective floor
+    reads None), and it restores when the peers rejoin.  The reaction
+    state and the dead fraction surface in fleet_summary."""
+    import dataclasses
+
+    from apex_tpu.config import small_test_config
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = small_test_config()
+    cfg = cfg.replace(comms=dataclasses.replace(
+        cfg.comms, relax_floor_dead_frac=0.5))
+    trainer = ApexTrainer(cfg, pool=_NullPool(), respawn_workers=False,
+                          train_ratio=8.0, min_train_ratio=0.5)
+    t = [0.0]
+    reg = FleetRegistry(cfg.comms, clock=lambda: t[0])
+    trainer.fleet = reg
+    reg.observe(Heartbeat("actor-0", role="actor"))
+    reg.observe(Heartbeat("actor-1", role="actor"))
+    trainer._react_to_fleet(0)
+    assert not trainer._floor_relaxed
+    assert trainer._min_ratio_effective() == 0.5
+
+    t[0] = 100.0
+    reg.tick()                                   # both DEAD
+    reg.observe(Heartbeat("actor-1", role="actor"))  # one rejoins
+    assert reg.dead_fraction() == pytest.approx(0.5)
+    trainer._react_to_fleet(0)
+    assert trainer._floor_relaxed
+    assert trainer._min_ratio_effective() is None
+    assert trainer.floor_relaxes == 1
+
+    reg.observe(Heartbeat("actor-0", role="actor"))  # capacity back
+    trainer._react_to_fleet(0)
+    assert not trainer._floor_relaxed
+    assert trainer._min_ratio_effective() == 0.5
+
+    summary = trainer.fleet_summary()["metrics"]
+    assert summary["floor_relaxes"] == 1
+    assert summary["floor_relaxed"] is False
+    assert summary["dead_actor_frac"] == 0.0
+    assert summary["learner_epoch"] == 1
+
+
+def test_learner_epoch_survives_and_bumps_through_restore(tmp_path):
+    """Epoch fencing through --restore: each restored life is one epoch
+    past the checkpoint's writer, monotonically, including pre-fencing
+    checkpoints (no learner_epoch in meta -> restore as life 2)."""
+    from apex_tpu.config import small_test_config
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = small_test_config()
+    trainer = ApexTrainer(cfg, pool=_NullPool(), respawn_workers=False,
+                          checkpoint_dir=str(tmp_path))
+    assert trainer.learner_epoch == 1            # first life
+    trainer.save_checkpoint()
+    trainer.restore()
+    assert trainer.learner_epoch == 2            # restart bumps
+    trainer.steps_rate.total += 1                # a newer checkpoint
+    trainer.save_checkpoint()
+    trainer.restore()
+    assert trainer.learner_epoch == 3            # monotone across lives
+    # a pre-fencing checkpoint (no epoch key) restores as life 2
+    trainer._apply_counters({"ingested": 0, "steps": 0,
+                             "param_version": 0})
+    assert trainer.learner_epoch == 2
+
+
+# -- HTTP metrics sidecar (PR 6 follow-up) -----------------------------------
+
+def test_http_metrics_sidecar_round_trip():
+    import urllib.request
+
+    from apex_tpu.obs.metrics import make_http_sidecar
+
+    comms = _comms()
+    reg = FleetRegistry(comms)
+    reg.observe(Heartbeat("actor-5", role="actor", fps=9.0))
+    srv = FleetStatusServer(comms, reg)
+    srv.start()
+    http_port = _free_ports(1)[0]
+    sidecar = make_http_sidecar(comms, port=http_port,
+                                learner_ip="127.0.0.1", bind="127.0.0.1")
+    t = threading.Thread(target=sidecar.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "# TYPE apex_fleet_alive gauge" in body
+        assert 'apex_fleet_peer_fps{identity="actor-5"} 9.0' in body
+        # non-metrics paths 404 instead of scraping
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/nope", timeout=10)
+    finally:
+        sidecar.shutdown()
+        sidecar.server_close()
+        srv.stop()
+
+
+def test_http_metrics_sidecar_503_when_learner_gone():
+    import urllib.error
+    import urllib.request
+
+    from apex_tpu.obs.metrics import make_http_sidecar
+
+    comms = _comms()                            # nothing listening
+    http_port = _free_ports(1)[0]
+    sidecar = make_http_sidecar(comms, port=http_port,
+                                learner_ip="127.0.0.1", bind="127.0.0.1",
+                                timeout_s=0.3)
+    t = threading.Thread(target=sidecar.serve_forever, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/metrics", timeout=10)
+        assert exc.value.code == 503
+    finally:
+        sidecar.shutdown()
+        sidecar.server_close()
 
 
 # -- adapters ---------------------------------------------------------------
@@ -617,7 +1090,8 @@ def test_socket_adapters_expose_fleet_hooks():
     chunk_ad = _ChunkQueueAdapter(sender, stop, park=park)
     param_ad = _ParamQueueAdapter(_Sub(), park=park)
     assert chunk_ad.wire_counters() == {"chunks_sent": 0,
-                                        "acks_received": 0}
+                                        "acks_received": 0,
+                                        "resends": 0, "rerouted": 0}
     assert param_ad.park_state() == (False, 0)
     chunk_ad.put(("chunk", 0, {"n": 1}))
     assert sender.sent == [{"n": 1}]

@@ -543,6 +543,326 @@ def test_replay_shard_chaos_drop_is_deterministic(monkeypatch):
     assert died == [("replay-1", 5)]
 
 
+# -- shard durability: kill -> snapshot restore bit-parity (PR 8) -----------
+
+def test_shard_kill_restore_bit_parity_with_in_learner(tmp_path):
+    """The PR 8 acceptance pin: strict-mode N=1 stays bit-identical to
+    the in-learner fused path ACROSS a kill/restore cycle — the shard is
+    snapshotted at a quiescent point, a FRESH core (different construction
+    key, proving restore overwrites everything) restores it, and the
+    remaining schedule lands on identical params, replay tree, and PRNG
+    chain."""
+    msgs = _chunk_messages(3, 14)
+    split_at = 9                         # kill after this many chunks
+
+    # reference: the in-learner serial loop (as in the N=1 parity test)
+    core_a, ts_a, replay_a = _learner()
+    rs = replay_a.init()
+    fused = core_a.jit_fused_step()
+    ingest = core_a.jit_ingest()
+    train = core_a.jit_train_step()
+    key_a = jax.random.key(999)
+    ingested = 0
+    for msg in msgs:
+        prios = jnp.asarray(np.asarray(msg["priorities"], np.float32))
+        if ingested >= WARMUP:
+            key_a, k = jax.random.split(key_a)
+            ts_a, rs, _ = fused(ts_a, rs, msg["payload"], prios, k,
+                                jnp.float32(_beta(ingested)))
+        else:
+            rs = ingest(rs, msg["payload"], prios)
+        ingested += int(msg["n_trans"])
+    for _ in range(2):
+        key_a, k = jax.random.split(key_a)
+        ts_a, rs, _ = train(ts_a, rs, k, jnp.float32(_beta(ingested)))
+
+    # service path with a mid-schedule kill/restore
+    core_b, ts_b, replay_b = _learner()
+    shard = ReplayShardCore(replay_b, jax.random.key(999), batch_size=BATCH,
+                            warmup=WARMUP, beta=0.4, beta_anneal=200,
+                            n_shards=1, strict_order=True)
+    train_b = jax.jit(core_b.update_from_batch, donate_argnums=(0,))
+
+    def pull_train_writeback(s):
+        nonlocal ts_b
+        b = s.next_batch()
+        assert b is not None
+        ts_b, prios_out, _ = train_b(ts_b, b["batch"],
+                                     jnp.asarray(b["weights"]))
+        s.write_back(b["seq"], b["idx"],
+                     np.asarray(jax.device_get(prios_out), np.float32))
+
+    for msg in msgs[:split_at]:
+        warm_pre = shard.warm
+        shard.ingest_msg(dict(msg))
+        if warm_pre:
+            pull_train_writeback(shard)
+
+    assert shard.quiescent()             # lockstep: nothing in flight
+    snap = str(tmp_path / "replay_shard_0.msgpack")
+    shard.save_snapshot(snap)
+
+    # the "respawned" shard: fresh core, deliberately different key —
+    # every restored field must come from the snapshot, none survive
+    _, _, replay_c = _learner()
+    shard2 = ReplayShardCore(replay_c, jax.random.key(424242),
+                             batch_size=BATCH, warmup=WARMUP, beta=0.4,
+                             beta_anneal=200, n_shards=1,
+                             strict_order=True)
+    meta = shard2.restore_snapshot(snap)
+    assert meta["ingested"] == shard.ingested
+    assert shard2.restored == shard.ingested
+    assert shard2.warm == shard.warm
+
+    for msg in msgs[split_at:]:
+        warm_pre = shard2.warm
+        shard2.ingest_msg(dict(msg))
+        if warm_pre:
+            pull_train_writeback(shard2)
+    for _ in range(2):
+        pull_train_writeback(shard2)
+
+    for la, lb in zip(jax.tree.leaves(ts_a.params),
+                      jax.tree.leaves(ts_b.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert int(ts_a.step) == int(ts_b.step)
+    for name in ("frames", "action", "reward", "discount", "obs_ids",
+                 "next_ids", "frame_epoch", "sum_tree", "min_tree",
+                 "pos", "f_epoch", "size", "max_priority"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rs, name)),
+            np.asarray(getattr(shard2.state, name)), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(key_a)),
+        np.asarray(jax.random.key_data(shard2.key)))
+
+
+def test_shard_restore_refuses_spec_mismatch(tmp_path):
+    _, _, replay = _learner(seed=9)
+    shard = ReplayShardCore(replay, jax.random.key(9), batch_size=BATCH,
+                            warmup=WARMUP, strict_order=True)
+    path = str(tmp_path / "snap.msgpack")
+    shard.save_snapshot(path)
+    _, _, replay2 = _learner(seed=9)
+    other = ReplayShardCore(replay2, jax.random.key(9),
+                            batch_size=BATCH // 2,     # shape-shifted
+                            warmup=WARMUP, strict_order=True)
+    with pytest.raises(ValueError, match="batch_size"):
+        other.restore_snapshot(path)
+
+
+# -- learner-epoch fencing on the replay plane (PR 8) ------------------------
+
+def test_epoch_fence_rejects_stale_writebacks_and_forgives_on_bump():
+    _, _, replay = _learner(seed=7)
+    shard = ReplayShardCore(replay, jax.random.key(7), batch_size=BATCH,
+                            warmup=WARMUP, strict_order=True)
+    msgs = iter(_chunk_messages(13, 20))
+    while not shard.warm:
+        shard.ingest_msg(next(msgs))
+    # epoch-1 learner pulls a batch, then dies before the write-back
+    assert shard.note_epoch(1) == 0
+    b0 = shard.next_batch()
+    assert b0 is not None and shard.outstanding() == 1
+    assert not shard.can_ingest()
+    # the restarted (epoch-2) learner's first pull forgives immediately —
+    # no dead_after_s wait — and reopens the ingest gate
+    assert shard.note_epoch(2) == 1
+    assert shard.epoch_forgiven == 1 and shard.can_ingest()
+    # the dead learner's ghost write-back: REJECTED, tree untouched
+    tree_before = np.asarray(shard.state.sum_tree).copy()
+    assert not shard.write_back(b0["seq"], b0["idx"],
+                                np.full(BATCH, 99.0, np.float32), epoch=1)
+    assert shard.stale_wb == 1
+    np.testing.assert_array_equal(tree_before,
+                                  np.asarray(shard.state.sum_tree))
+    # the live epoch trains on: sample, write back, applied
+    shard.ingest_msg(next(msgs))
+    b1 = shard.next_batch()
+    assert shard.write_back(b1["seq"], b1["idx"],
+                            np.ones(BATCH, np.float32), epoch=2)
+    assert shard.wb_applied == shard.sampled
+    # unstamped (legacy) write-backs keep working when fencing is off
+    stats = shard.stats()
+    assert stats["learner_epoch"] == 2 and stats["stale_wb"] == 1
+
+
+def test_epoch_skew_chaos_drill_over_sockets(monkeypatch):
+    """Seeded epoch-skew injection: the learner's write-backs arrive one
+    epoch STALE; the shard rejects and counts every one, reports the
+    count on the dry reply, and its priorities stay uncorrupted."""
+    monkeypatch.setenv("CHAOS_SEED", "11")
+    monkeypatch.setenv("CHAOS_SPEC", '{"epoch_skew": {"learner": -1}}')
+    comms = _comms(1)
+    fleet = _ShardFleet(comms, 1, warmup=1)
+    sender = ShardedChunkSender(comms, "actor-0", shard_wait_s=5.0)
+    client = ReplayServiceClient(comms, identity="learner")
+    client.learner_epoch = 2                  # the trainer's stamp
+    assert client.epoch_skew == -1            # seeded plan applied
+    try:
+        for i, msg in enumerate(_chunk_messages(51, 3)):
+            assert sender.send_chunk(dict(msg, chunk_id=f"actor-0:{i}"))
+        item = client.poll_batch(timeout=20)
+        assert item is not None
+        assert client.push_priorities(item["shard"], item["seq"],
+                                      item["idx"],
+                                      np.ones(BATCH, np.float32))
+        core = fleet.servers[0].core
+        deadline = time.monotonic() + 10
+        while core.stale_wb == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert core.stale_wb == 1             # rejected, counted
+        assert core.wb_applied < core.sampled  # never applied
+        # the dry reply carries the reject count back to the learner
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            client.poll_batch(timeout=0.2)
+            if client.shard_status()[0]["stale_wb"] >= 1:
+                break
+        assert client.shard_status()[0]["stale_wb"] >= 1
+    finally:
+        client.close()
+        sender.close(drain_s=0)
+        fleet.close()
+
+
+# -- partition-grade chaos plans (PR 8) --------------------------------------
+
+def test_chaos_partition_plan_fields():
+    from apex_tpu.fleet.chaos import ChaosConfig
+
+    cfg = ChaosConfig(7, {"ack_withhold": {"at": 3, "n": 2},
+                          "mute": ["replay-0"],
+                          "epoch_skew": {"learner": -1}})
+    p = cfg.plan_for("learner")
+    assert p.ack_withhold_at == 3 and p.ack_withhold_n == 2
+    assert p.ack_withhold_s == 3.0            # hold_s default
+    assert p.epoch_skew == -1 and not p.mute_replies
+    q = cfg.plan_for("replay-0")
+    assert q.mute_replies and q.epoch_skew == 0
+    # a respawned life keeps the partition faults (only kills disarm)
+    r = ChaosConfig(7, {"mute": ["replay-0"], "kill": {"replay-0": 5}},
+                    respawn_count=1).plan_for("replay-0")
+    assert r.mute_replies and r.kill_at is None
+
+
+def test_directional_drop_shard_ingests_but_replies_vanish(monkeypatch):
+    """actor->shard up while shard->learner down: chunks keep landing
+    and acking (the ingress direction is healthy), pulls arrive but
+    every reply dies on the muted link — counted, and the learner's
+    status for that shard stays dark."""
+    monkeypatch.setenv("CHAOS_SEED", "13")
+    monkeypatch.setenv("CHAOS_SPEC", '{"mute": ["replay-0"]}')
+    comms = _comms(1)
+    fleet = _ShardFleet(comms, 1)
+    sender = ShardedChunkSender(comms, "actor-0", shard_wait_s=5.0)
+    client = ReplayServiceClient(comms, identity="learner-dd")
+    try:
+        msgs = _chunk_messages(61, 3)
+        for i, msg in enumerate(msgs):
+            assert sender.send_chunk(dict(msg, chunk_id=f"actor-0:{i}"))
+        deadline = time.monotonic() + 10
+        while (fleet.servers[0].core.chunks < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert fleet.servers[0].core.chunks == 3    # ingress healthy
+        assert client.poll_batch(timeout=1.5) is None   # egress dark
+        assert fleet.servers[0].chaos_muted >= 1
+        assert client.shard_status()[0]["ingested"] == 0
+    finally:
+        client.close()
+        sender.close(drain_s=0)
+        fleet.close()
+
+
+# -- dead-shard re-probe (PR 8 fix) ------------------------------------------
+
+def test_recovered_shard_gets_its_traffic_back_via_reprobe():
+    """The satellite fix: a dead shard's stale credit window used to
+    wedge it out FOREVER (every later chunk fell back to the learner,
+    even after the shard respawned).  With periodic re-probing the
+    window resets and a recovered shard takes its stream back — no
+    actor restart."""
+    comms = _comms(1, max_outstanding_sends=2)
+    receiver = transport.ChunkReceiver(comms, bind_ip="127.0.0.1",
+                                       queue_depth=64)
+    receiver.start()
+    fleet = _ShardFleet(comms, 1)
+    sender = ShardedChunkSender(comms, "actor-0", shard_wait_s=0.3,
+                                shard_reprobe_s=0.6)
+    try:
+        msgs = _chunk_messages(71, 12)
+        # first chunk alone: its ingest jit-compiles, which would blow
+        # the deliberately short shard_wait_s for the chunks behind it
+        assert sender.send_chunk(dict(msgs[0], chunk_id="actor-0:0"))
+        deadline = time.monotonic() + 20
+        while (fleet.servers[0].core.chunks < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert fleet.servers[0].core.chunks == 1
+        for i in range(1, 3):
+            assert sender.send_chunk(dict(msgs[i],
+                                          chunk_id=f"actor-0:{i}"))
+        deadline = time.monotonic() + 10
+        while (fleet.servers[0].core.chunks < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert fleet.servers[0].core.chunks == 3
+
+        fleet.kill(0)
+        # drain the last acks, then wedge the window exactly as a
+        # mid-flight kill leaves it (same idiom as the park test — in
+        # this in-process topology zmq would otherwise buffer and
+        # redeliver, which a crashed remote host does not)
+        deadline = time.monotonic() + 5
+        while (sender.shards[0]._in_flight > 0
+               and time.monotonic() < deadline):
+            sender.shards[0]._drain_acks(50)
+        sender.shards[0]._in_flight = comms.max_outstanding_sends
+        for i in range(3, 7):               # window wedged -> fallback
+            assert sender.send_chunk(dict(msgs[i],
+                                          chunk_id=f"actor-0:{i}"),
+                                     max_wait_s=5)
+        # wedged chunks fell back (the burst may outlast the re-probe
+        # period, in which case the last one rides an early probe into
+        # the still-dead shard — the documented bounded loss)
+        assert sender.rerouted >= 3
+
+        # the shard respawns on the same port (fresh core = no memory
+        # of the old acks)
+        _, _, replay = _learner(seed=99)
+        core2 = ReplayShardCore(replay, jax.random.key(99),
+                                batch_size=BATCH, warmup=10_000,
+                                strict_order=True)
+        stop2 = threading.Event()
+        srv2 = ReplayShardServer(comms, 0, core2, bind_ip="127.0.0.1",
+                                 heartbeat=False)
+        t2 = threading.Thread(target=srv2.run,
+                              kwargs={"stop_event": stop2}, daemon=True)
+        t2.start()
+        try:
+            time.sleep(0.7)                 # past shard_reprobe_s
+            deadline = time.monotonic() + 20
+            i = 7
+            while core2.chunks == 0 and time.monotonic() < deadline:
+                assert sender.send_chunk(dict(msgs[i % len(msgs)],
+                                              chunk_id=f"actor-0:{i}"),
+                                         max_wait_s=5)
+                i += 1
+                time.sleep(0.05)
+            assert core2.chunks > 0, \
+                "recovered shard never got its traffic back"
+            assert sender.reprobes >= 1
+        finally:
+            stop2.set()
+            t2.join(timeout=10)
+            srv2.close()
+    finally:
+        sender.close(drain_s=0)
+        fleet.close()
+        receiver.stop()
+
+
 class _StubPool:
     """No-chunk pool: the trainer must train on SERVICE batches alone."""
 

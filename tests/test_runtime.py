@@ -233,6 +233,28 @@ def test_cli_replay_service_flags_and_env_twins(monkeypatch):
     assert not cfg.comms.replay_strict_order
 
 
+def test_cli_shard_snapshot_flags_and_env_twins(monkeypatch):
+    """Shard durability knobs (PR 8): snapshot dir/cadence have env
+    twins so run_local.sh and the deploy bootstraps configure the whole
+    shard fleet with two exports."""
+    from apex_tpu.runtime.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args([])
+    assert args.replay_snapshot_dir is None
+    assert config_from_args(args).comms.replay_snapshot_s == 0.0
+
+    monkeypatch.setenv("APEX_REPLAY_SNAPSHOT_DIR", "/tmp/snaps")
+    monkeypatch.setenv("APEX_REPLAY_SNAPSHOT_S", "2.5")
+    args = build_parser().parse_args([])
+    assert args.replay_snapshot_dir == "/tmp/snaps"
+    assert config_from_args(args).comms.replay_snapshot_s == 2.5
+
+    args = build_parser().parse_args(["--replay-snapshot-dir", "/e",
+                                      "--replay-snapshot-every", "9"])
+    assert args.replay_snapshot_dir == "/e"     # flags beat env twins
+    assert config_from_args(args).comms.replay_snapshot_s == 9.0
+
+
 @pytest.mark.slow
 def test_actor_rejoin_after_kill_clears_silent_peers():
     """The supervisor-respawn contract (deploy/actor.sh + roles.py
