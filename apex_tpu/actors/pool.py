@@ -286,7 +286,8 @@ class ActorPool:
         self.stat_queue: mp.Queue = ctx.Queue(maxsize=1024)
         self.param_queues = [ctx.Queue(maxsize=2) for _ in range(n)]
         self.stop_event = ctx.Event()
-        if cfg.actor.n_envs_per_actor > 1:
+        if cfg.actor.n_envs_per_actor > 1 or getattr(
+                cfg.actor, "remote_policy", False):
             if worker_fn is not None and not getattr(worker_fn, "is_vector",
                                                      False):
                 # silently falling back to one env/process would run a

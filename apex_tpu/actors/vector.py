@@ -76,6 +76,12 @@ class VectorFamilyBase:
     accounting/reset behavior.
     """
 
+    #: remote-policy client (apex_tpu/infer_service) — None = local
+    #: acting; families that ship their half-groups to the infer server
+    #: set ``supports_remote`` and route through it in ``_policy_group``
+    infer = None
+    supports_remote = False
+
     def __init__(self, cfg: ApexConfig, seeds, slot_ids, epsilons):
         from apex_tpu.utils.profiling import DispatchGapTimer, PhaseTimer
 
@@ -123,9 +129,24 @@ class VectorFamilyBase:
             obs, _ = env.reset(seed=seed)
             self._on_reset(i, obs)
 
+    def attach_infer(self, client) -> None:
+        """Route this family's half-group policy calls through the
+        inference plane (``ActorConfig.remote_policy``).  The local
+        policy stays jitted as the fallback — remote and local are
+        bit-identical for the same params + key chain, so attaching the
+        client changes scheduling, never trajectories."""
+        if not self.supports_remote:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no remote-policy path — "
+                f"ActorConfig.remote_policy currently serves the DQN "
+                f"vector family only (see ROADMAP.md)")
+        self.infer = client
+
     def close(self) -> None:
         for env in self.envs:
             env.close()
+        if self.infer is not None:
+            self.infer.close()
 
     # -- the double-buffered vector step -----------------------------------
 
@@ -202,7 +223,14 @@ class VectorFamilyBase:
     @staticmethod
     def _materialize(out) -> tuple:
         """The one blocking device->host sync per group, immediately before
-        the group's envs consume the results."""
+        the group's envs consume the results.  A remote-policy pending
+        handle (:class:`~apex_tpu.infer_service.client.PendingInfer`)
+        blocks here on the reply — or the local fallback after
+        ``infer_wait_s`` — at exactly the site the local path pays its
+        ``np.asarray``."""
+        mat = getattr(out, "materialize", None)
+        if mat is not None:
+            return mat()
         return tuple(np.asarray(x) for x in out)
 
     def _step_group(self, sl: slice, host: tuple, stats: list) -> None:
@@ -280,6 +308,8 @@ class VectorDQNWorkerFamily(VectorChunkFamilyBase):
     """B-env DQN acting/recording: the vector counterpart of
     :class:`apex_tpu.actors.pool.DQNWorkerFamily`."""
 
+    supports_remote = True      # half-groups can ship to the infer server
+
     def __init__(self, cfg: ApexConfig, model_spec: dict, seeds,
                  slot_ids, epsilons, chunk_transitions: int):
         from apex_tpu.envs.registry import unstacked_env_spec
@@ -301,6 +331,19 @@ class VectorDQNWorkerFamily(VectorChunkFamilyBase):
         self._bind_acting_buffer()
 
     def _policy_group(self, params, sl: slice, eps, key, group: int):
+        if self.infer is not None:
+            # remote policy: ship this half-group's stacked obs + ladder
+            # slice + RAW step key + group id; fold_in happens server-
+            # side in the same program the local jit runs.  The fallback
+            # closure reads the SAME acting-buffer rows it shipped —
+            # those rows only mutate in _step_group, which runs strictly
+            # after this group's materialize, so remote timeout or not,
+            # the inputs (hence outputs) are bit-identical.
+            return self.infer.submit(
+                self._acting[sl], np.asarray(eps), key, group,
+                # apexlint: disable=J004 -- remote and fallback run the SAME fold_in(key, group) program; exactly one result is consumed, so the draw is used once
+                fallback=lambda: self.policy(params, self._acting[sl],
+                                             eps, key, group))
         return self.policy(params, self._acting[sl], eps, key, group)
 
     def _step_group(self, sl: slice, host: tuple, stats: list) -> None:
@@ -360,7 +403,11 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         f"actor-{actor_id}", role="actor",
         interval_s=cfg.comms.heartbeat_interval_s,
         counters_fn=getattr(chunk_queue, "wire_counters", None),
-        park_fn=getattr(param_queue, "park_state", None))
+        park_fn=getattr(param_queue, "park_state", None),
+        # remote-policy health (fallback count, round-trip percentiles)
+        # rides the same beats the registry already consumes
+        gauges_fn=(family.infer.gauges if family.infer is not None
+                   else None))
     version, params = 0, None
     while True:                                  # block for first publish
         if stop_event.is_set():
@@ -456,6 +503,11 @@ def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
     family = VectorDQNWorkerFamily(
         cfg, model_spec, seeds=seeds, slot_ids=slot_ids, epsilons=epsilons,
         chunk_transitions=chunk_transitions)
+    if getattr(cfg.actor, "remote_policy", False):
+        # centralized inference: the half-group policy calls ship to the
+        # infer server; the family's local jit stays as the fallback
+        from apex_tpu.infer_service.client import InferClient
+        family.attach_infer(InferClient(cfg.comms, f"actor-{actor_id}"))
     vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
                        stat_queue, stop_event)
 

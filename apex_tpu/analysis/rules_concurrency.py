@@ -274,25 +274,10 @@ class ZmqSocketLeak(_LifecycleRule):
                                "cleanup"})
 
     def _is_resource_call(self, node: ast.Call, ctx: ModuleContext) -> bool:
+        # <ctx>.socket(zmq.ROUTER)-shaped creations (shared with J013)
+        if _is_zmq_socket_call(node):
+            return True
         f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "socket":
-            # receiver looks like a zmq context, or the socket type arg is
-            # rooted at the zmq module (ctx.socket(zmq.ROUTER))
-            for arg in node.args:
-                root = arg
-                while isinstance(root, ast.Attribute):
-                    root = root.value
-                if isinstance(root, ast.Name) and root.id == "zmq":
-                    return True
-            recv = f.value
-            if isinstance(recv, ast.Name) and recv.id in ("zmq", "ctx",
-                                                          "context"):
-                return True
-            if isinstance(recv, ast.Call):
-                base = _callee_basename(recv) or ""
-                return "ctx" in base.lower() or "context" in base.lower() \
-                    or base == "instance"
-            return False
         # zmq.Context() construction (NOT .instance(): shared singleton)
         if isinstance(f, ast.Attribute) and f.attr == "Context" \
                 and isinstance(f.value, ast.Name) and f.value.id == "zmq":
@@ -468,6 +453,31 @@ class NakedPickleLoads(Rule):
         return out
 
 
+# -- shared zmq-socket detection (C002 + J013) ------------------------------
+
+
+def _is_zmq_socket_call(node: ast.Call) -> bool:
+    """``<ctx>.socket(zmq.X)``-shaped creations (the C002 detection,
+    factored out so J013 tracks the same attribute population)."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "socket"):
+        return False
+    for arg in node.args:
+        root = arg
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id == "zmq":
+            return True
+    recv = f.value
+    if isinstance(recv, ast.Name) and recv.id in ("zmq", "ctx", "context"):
+        return True
+    if isinstance(recv, ast.Call):
+        base = _callee_basename(recv) or ""
+        return "ctx" in base.lower() or "context" in base.lower() \
+            or base == "instance"
+    return False
+
+
 # -- J012 -------------------------------------------------------------------
 
 
@@ -543,3 +553,120 @@ class PortCollision(Rule):
                             and isinstance(stmt.value.value, int):
                         ports[t.id] = stmt.value.value
         return self._collide(ctx, cls, ports) if len(ports) > 1 else []
+
+
+# -- J013 -------------------------------------------------------------------
+
+
+@register
+class ZmqThreadAffinity(Rule):
+    id = "J013"
+    name = "zmq-thread-affinity"
+    description = ("a zmq socket attribute of one class is touched from "
+                   "two different thread-entry methods (Thread targets): "
+                   "zmq sockets are not thread-safe, and concurrent use "
+                   "from two threads corrupts the socket state — route "
+                   "one thread's work through a queue the other drains "
+                   "(the ChunkReceiver ack-queue pattern)")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            out.extend(self._check_class(ctx, cls))
+        return out
+
+    @staticmethod
+    def _methods(cls: ast.ClassDef) -> dict[str, ast.AST]:
+        return {n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    @staticmethod
+    def _socket_attrs(cls: ast.ClassDef) -> set[str]:
+        """Attributes assigned from a zmq socket creation anywhere in the
+        class body (``self.x = ctx.socket(zmq.ROUTER)``)."""
+        attrs: set[str] = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _is_zmq_socket_call(n.value):
+                for t in n.targets:
+                    a = _self_attr(t)
+                    if a:
+                        attrs.add(a)
+        return attrs
+
+    @staticmethod
+    def _thread_entries(cls: ast.ClassDef,
+                        methods: dict[str, ast.AST]) -> list[str]:
+        """Methods handed to ``threading.Thread(target=self.m)`` inside
+        the class — each is one thread's entry point."""
+        entries = []
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call)
+                    and _callee_basename(n) == "Thread"):
+                continue
+            target = _kwarg(n, "target")
+            if target is None:
+                continue
+            m = _self_attr(target)
+            if m and m in methods and m not in entries:
+                entries.append(m)
+        return entries
+
+    @classmethod
+    def _reachable(cls_, entry: str,
+                   methods: dict[str, ast.AST]) -> set[str]:
+        """Intra-class call-graph closure from ``entry``: a socket touch
+        in a helper belongs to every thread whose entry reaches it."""
+        seen, stack = set(), [entry]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in methods:
+                continue
+            seen.add(m)
+            for n in ast.walk(methods[m]):
+                if isinstance(n, ast.Call):
+                    callee = _self_attr(n.func)
+                    if callee and callee in methods:
+                        stack.append(callee)
+        return seen
+
+    @staticmethod
+    def _touched(method: ast.AST, socket_attrs: set[str]) -> set[str]:
+        out = set()
+        for n in ast.walk(method):
+            a = _self_attr(n) if isinstance(n, ast.Attribute) else None
+            if a in socket_attrs:
+                out.add(a)
+        return out
+
+    def _check_class(self, ctx: ModuleContext,
+                     cls: ast.ClassDef) -> list[Finding]:
+        socket_attrs = self._socket_attrs(cls)
+        if not socket_attrs:
+            return []
+        methods = self._methods(cls)
+        entries = self._thread_entries(cls, methods)
+        if len(entries) < 2:
+            return []               # one thread (or none) cannot race
+        touched_by: dict[str, list[str]] = {}
+        for entry in entries:
+            reach = self._reachable(entry, methods)
+            for m in reach:
+                for attr in self._touched(methods[m], socket_attrs):
+                    owners = touched_by.setdefault(attr, [])
+                    if entry not in owners:
+                        owners.append(entry)
+        out = []
+        for attr in sorted(touched_by):
+            owners = touched_by[attr]
+            if len(owners) > 1:
+                out.append(ctx.finding(
+                    self, cls,
+                    f"zmq socket 'self.{attr}' of {cls.name} is touched "
+                    f"from {len(owners)} thread-entry methods "
+                    f"({', '.join(sorted(owners))}) — zmq sockets are "
+                    f"single-threaded; keep one owning thread and hand "
+                    f"the others a queue (ChunkReceiver routes decoder "
+                    f"acks through _ack_q for exactly this reason)"))
+        return out

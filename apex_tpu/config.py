@@ -156,6 +156,19 @@ class ActorConfig:
     # / env-step / drain fractions + frames/s, shipped on the stat queue
     # and surfaced in the learner logs and bench "actor_plane").  0 = off.
     timing_interval: int = 256
+    # Centralized batched inference (apex_tpu/infer_service): instead of
+    # running the policy on the actor host's CPU, each half-group's
+    # stacked observations ship to the `--role infer` server, which
+    # batches requests ACROSS actor processes into one device dispatch
+    # and returns (actions, q, param_version).  Rides the double-buffer
+    # split: one group's round-trip overlaps the other group's env
+    # stepping.  Remote-served results are BIT-IDENTICAL to the local
+    # policy for the same params + key chain (tests/test_infer.py pins
+    # it), and every actor keeps its local policy as the fallback — a
+    # wedged/dead server costs comms.infer_wait_s once, then the actor
+    # runs local until the re-probe finds the server again.  DQN vector
+    # families only (the AQL/R2D2 remote families are ROADMAP items).
+    remote_policy: bool = False
 
 
 @dataclass(frozen=True)
@@ -286,6 +299,35 @@ class CommsConfig:
     # supervised respawn restores it, rejoining WARM instead of refilling
     # from live streams.  0 = snapshots off (the pre-PR-8 behavior).
     replay_snapshot_s: float = 0.0
+    # -- centralized inference plane (apex_tpu/infer_service) --------------
+    # `--role infer` binds ONE ROUTER here; remote-policy actors connect
+    # their per-worker DEALERs to it (ActorConfig.remote_policy).
+    infer_port: int = 54001
+    infer_ip: str = "127.0.0.1"      # host the infer server runs on
+    # Adaptive request coalescing: the server collects policy requests
+    # until infer_batch_max are queued OR infer_window_ms elapsed since
+    # the first, then runs them as ONE scan-stacked device dispatch
+    # (request count padded to pow2-quantized widths so compile count
+    # stays bounded — the PR 2 scan-stack discipline).
+    infer_batch_max: int = 16
+    infer_window_ms: float = 2.0
+    # Actor-side fallback: a request unanswered for this long falls back
+    # to the LOCAL policy (bit-identical by the parity contract, so the
+    # fallback changes scheduling, never trajectories) and marks the
+    # server down — a dead/wedged infer server never stalls an actor
+    # beyond one wait (the learner-direct fallback contract from the
+    # replay service, applied to inference).
+    infer_wait_s: float = 1.0
+    # While the server is marked down the actor runs local-only and
+    # re-probes with one real request every this many seconds, so a
+    # supervised respawn gets its traffic back without an actor restart
+    # (the PR 8 dead-shard re-probe discipline).
+    infer_reprobe_s: float = 5.0
+    # Keep the server's params device-placed (device_put on every
+    # subscribed publish).  On a shared-device deployment this is the
+    # device-to-device copy path; skipped automatically on the CPU
+    # backend (same gate as the ingest pipeline's staging ring).
+    infer_device_params: bool = False
 
 
 @dataclass(frozen=True)

@@ -49,6 +49,12 @@ class Heartbeat:
     # clock offset (skew + transit) — the alignment input for
     # ``python -m apex_tpu.obs.merge`` cross-host trace merging
     wall_ts: float = 0.0
+    # role-specific serving gauges (plain str -> number dict, so the
+    # restricted wire carries it): the infer server ships queue depth /
+    # batch-size percentiles, remote-policy actors ship fallback counts
+    # and round-trip percentiles — surfaced on the `--role status` table
+    # and the Prometheus exposition.  None = role has nothing extra.
+    gauges: dict | None = None
 
 
 class HeartbeatEmitter:
@@ -64,12 +70,13 @@ class HeartbeatEmitter:
 
     def __init__(self, identity: str, role: str = "actor",
                  interval_s: float = 2.0, counters_fn=None, park_fn=None,
-                 clock=time.monotonic):
+                 gauges_fn=None, clock=time.monotonic):
         self.identity = identity
         self.role = role
         self.interval_s = interval_s
         self.counters_fn = counters_fn
         self.park_fn = park_fn
+        self.gauges_fn = gauges_fn
         self._clock = clock
         self._pid = os.getpid()
         self._host = socket.gethostname()
@@ -105,4 +112,6 @@ class HeartbeatEmitter:
             rejoins=int(rejoins), parked=bool(parked),
             resends=int(counters.get("resends", 0)),
             rerouted=int(counters.get("rerouted", 0)),
-            wall_ts=time.time())
+            wall_ts=time.time(),
+            gauges=(dict(self.gauges_fn())
+                    if self.gauges_fn is not None else None))

@@ -83,6 +83,10 @@ class PeerState:
     clock_offset_s: float | None = None
     clock_offset_n: int = 0         # samples behind the estimate
     offset_samples: deque = field(default_factory=lambda: deque(maxlen=16))
+    # latest role-specific serving gauges off the peer's heartbeats
+    # (infer server: queue depth / batch percentiles; remote-policy
+    # actors: fallback counts / round-trip percentiles)
+    gauges: dict = field(default_factory=dict)
 
 
 class FleetRegistry:
@@ -139,6 +143,9 @@ class FleetRegistry:
             p.rerouted = getattr(hb, "rerouted", 0)
             p.rejoins_reported = max(p.rejoins_reported, hb.rejoins)
             p.parked = hb.parked
+            gauges = getattr(hb, "gauges", None)
+            if gauges:
+                p.gauges = dict(gauges)
             wall_ts = getattr(hb, "wall_ts", 0.0)
             if wall_ts:
                 p.offset_samples.append(self._wall() - wall_ts)
@@ -248,6 +255,7 @@ class FleetRegistry:
                 "silent_s": round(now - p.last_any, 1),
                 "clock_offset_s": p.clock_offset_s,
                 "clock_offset_n": p.clock_offset_n,
+                "gauges": dict(p.gauges),
             } for _, p in sorted(self.peers.items())]
         return {"peers": peers, "metrics": self.metrics()}
 
@@ -270,6 +278,15 @@ def format_fleet_table(snapshot: dict) -> str:
         f"rejoins={m.get('rejoins')} "
         f"hb_gap_p50={m.get('hb_gap_p50_s')}s "
         f"p99={m.get('hb_gap_p99_s')}s")
+    # role-specific serving gauges (the inference plane's queue depth /
+    # batch percentiles, remote-policy actors' fallback counts) — one
+    # line per peer that reported any, so new roles are never a blind
+    # spot on the operator surface
+    for p in snapshot["peers"]:
+        g = p.get("gauges")
+        if g:
+            lines.append(f"{p['identity']}: " + " ".join(
+                f"{k}={g[k]}" for k in sorted(g)))
     return "\n".join(lines)
 
 

@@ -1,0 +1,37 @@
+"""Centralized batched inference plane — a device-attached policy server
+for the actor fleet.
+
+Today every actor host runs its own policy copy on host CPU and pays B
+tiny forward passes per vector step plus the full serialize→publish→
+deserialize param cycle per refresh.  "Human-Level Control without
+Server-Grade Hardware" (arxiv 2111.01264) shows the economics of batching
+actor inference centrally; Stooke & Abbeel (arxiv 1803.02811 — the basis
+of the actor plane's double buffering) covers the overlap scheduling that
+hides the round-trip.  This package is that server for the apex-tpu
+fleet:
+
+* :mod:`~apex_tpu.infer_service.service` — the ``--role infer`` process:
+  one ROUTER that coalesces policy requests ACROSS actor processes into
+  scan-stacked device dispatches, with params kept fresh off the
+  existing learner param channel (optionally device-resident).
+* :mod:`~apex_tpu.infer_service.client` — the actor-side half:
+  ``ActorConfig.remote_policy`` makes each half-group's
+  ``_policy_group`` dispatch a wire request instead of a local jit call
+  (riding the double-buffer split, so one group's round-trip overlaps
+  the other group's env stepping), with local-policy fallback after
+  ``comms.infer_wait_s`` and the dead-shard re-probe discipline so a
+  wedged/dead server never stalls the fleet.
+
+Bit-parity is the correctness anchor: for identical params and key
+chains, remote-served actions/chunks/priorities are bit-identical to the
+local-policy path (tests/test_infer.py pins it across even/odd B and
+both half-groups), so the remote/local A/B measures pure plumbing cost
+vs batching win.
+"""
+
+from apex_tpu.infer_service.client import InferClient
+from apex_tpu.infer_service.service import (InferServer, quantize_pow2,
+                                            run_infer_server)
+
+__all__ = ["InferClient", "InferServer", "quantize_pow2",
+           "run_infer_server"]

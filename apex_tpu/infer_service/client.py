@@ -1,0 +1,233 @@
+"""Actor-side half of the inference plane.
+
+One DEALER per worker, driven by EXACTLY the worker thread (submit and
+collect both happen inside the vector step — the zmq single-thread
+contract apexlint J013 enforces).  ``submit`` ships one half-group's
+policy inputs and returns a :class:`PendingInfer` WITHOUT blocking, so
+the double-buffered interleave dispatches both groups' requests before
+materializing either — one group's round-trip overlaps the other group's
+env stepping, and the two requests land in the same server batch window.
+
+Fallback contract (the replay service's learner-direct fallback, applied
+to inference): ``collect`` waits at most ``comms.infer_wait_s`` for the
+reply, then computes the SAME program locally — bit-identical by the
+parity pin, so a fallback is a scheduling event, never a trajectory
+fork.  A timeout marks the server DOWN: subsequent submits skip the wire
+entirely (local acting at full speed) and one real request re-probes the
+server every ``comms.infer_reprobe_s``, so a supervised respawn gets its
+traffic back without an actor restart (the PR 8 dead-shard re-probe
+discipline — a stale down-marker must never wedge a recovered server
+out).
+
+Epoch fencing (PR 8): every reply carries the learner epoch the server
+acted under; a reply stamped with an OLDER epoch than the newest this
+client has seen is a dead learner life's straggler — discarded
+(counted), never acted on.
+
+Replies are decoded through the restricted wire unpickler: a compromised
+or corrupt server costs counted drops and local fallbacks, never
+execution.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from apex_tpu.config import CommsConfig
+from apex_tpu.obs import spans as obs_spans
+from apex_tpu.obs.spans import LatencyHistogram
+from apex_tpu.runtime import wire
+
+
+class PendingInfer:
+    """One in-flight half-group request; ``materialize()`` is the single
+    blocking point, exactly where the local path's ``np.asarray`` sync
+    sits."""
+
+    __slots__ = ("client", "rid", "sent", "fallback", "t0")
+
+    def __init__(self, client: "InferClient", rid: int, sent: bool,
+                 fallback, t0: float):
+        self.client = client
+        self.rid = rid
+        self.sent = sent
+        self.fallback = fallback
+        self.t0 = t0
+
+    def materialize(self) -> tuple:
+        return self.client.collect(self)
+
+
+class InferClient:
+    """Submit/collect pairs over one DEALER, with local fallback and the
+    down-marker/re-probe machine."""
+
+    def __init__(self, comms: CommsConfig, identity: str,
+                 infer_ip: str | None = None, wait_s: float | None = None,
+                 reprobe_s: float | None = None, clock=time.monotonic):
+        import zmq
+
+        self._zmq = zmq
+        self.comms = comms
+        self.identity = identity
+        self._clock = clock
+        self.sock = zmq.Context.instance().socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, f"{identity}-infer".encode())
+        # bounded send queue: requests to a dead server must fail fast
+        # into the local fallback, not pile up in a kernel buffer
+        self.sock.setsockopt(zmq.SNDHWM, 16)
+        ip = infer_ip or comms.infer_ip
+        self.sock.connect(f"tcp://{ip}:{comms.infer_port}")
+        self.wait_s = (comms.infer_wait_s if wait_s is None
+                       else float(wait_s))
+        self.reprobe_s = (comms.infer_reprobe_s if reprobe_s is None
+                          else float(reprobe_s))
+        self._rid = 0
+        self._replies: dict[int, dict | None] = {}
+        self._outstanding: set[int] = set()
+        self._down_since: float | None = None
+        # counters (heartbeat gauges + bench part-1e)
+        self.remote_steps = 0
+        self.fallbacks = 0
+        self.stale_epoch = 0
+        self.rejected = 0
+        self.reprobes = 0
+        self.round_trip = LatencyHistogram()
+        self.epoch_seen = 0             # newest learner epoch in a reply
+        self.last_version = 0           # newest param version in a reply
+        from apex_tpu.obs.trace import get_ring
+        self._ring = get_ring()
+
+    # -- submit/collect ------------------------------------------------------
+
+    def _remote_ok(self) -> bool:
+        """False while the server is marked down — except one real probe
+        per re-probe period (a respawned server has no memory of the
+        timeouts that marked it down; the probe is how it gets its
+        traffic back)."""
+        if self._down_since is None:
+            return True
+        if self.reprobe_s > 0 and (self._clock() - self._down_since
+                                   >= self.reprobe_s):
+            self._down_since = self._clock()
+            self.reprobes += 1
+            return True
+        return False
+
+    def submit(self, obs, eps, key, group: int, fallback) -> PendingInfer:
+        """Ship one half-group request (non-blocking) and hand back the
+        pending handle; ``fallback`` is the zero-argument local policy
+        call producing the bit-identical ``(actions, q)``."""
+        import jax
+
+        rid = self._rid
+        self._rid += 1
+        t0 = self._clock()
+        sent = False
+        if self._remote_ok():
+            msg = {"rid": rid, "obs": np.asarray(obs),
+                   "eps": np.asarray(eps, np.float32),
+                   "key": np.asarray(jax.random.key_data(key)),
+                   "group": int(group)}
+            if obs_spans.enabled():
+                msg[obs_spans.SPAN_KEY] = [
+                    obs_spans.new_span(hop="infer_send")]
+            try:
+                self.sock.send(wire.dumps(("infer", msg)),
+                               self._zmq.DONTWAIT)
+                sent = True
+                self._outstanding.add(rid)
+            except self._zmq.Again:
+                pass            # full send queue == down server: fall back
+        return PendingInfer(self, rid, sent, fallback, t0)
+
+    def collect(self, pending: PendingInfer) -> tuple:
+        """The one blocking point: the reply within ``wait_s``, else the
+        local fallback (and the down-marker so later steps skip the
+        wait)."""
+        rid = pending.rid
+        if pending.sent:
+            deadline = pending.t0 + self.wait_s
+            while True:
+                self._drain()
+                if rid in self._replies:
+                    rep = self._replies.pop(rid)
+                    self._outstanding.discard(rid)
+                    if rep is not None:
+                        self._down_since = None
+                        self.remote_steps += 1
+                        rt = self._clock() - pending.t0
+                        self.round_trip.record(rt)
+                        self._ring.complete("infer_rt", pending.t0, rt,
+                                            track="infer-client")
+                        return (np.asarray(rep["actions"]),
+                                np.asarray(rep["q"]))
+                    break       # dry reply: the server has no params yet
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    self._outstanding.discard(rid)
+                    if self._down_since is None:
+                        self._down_since = self._clock()
+                    break
+                self.sock.poll(min(50.0, remaining * 1000.0),
+                               self._zmq.POLLIN)
+        self.fallbacks += 1
+        out = pending.fallback()
+        return tuple(np.asarray(x) for x in out)
+
+    def _drain(self) -> None:
+        """Decode every queued reply; file by rid.  Stale-epoch replies
+        (an older learner life's stragglers) are counted and DISCARDED —
+        acting on a dead life's policy output would smuggle pre-restart
+        staleness past the fencing every other plane enforces."""
+        while self.sock.poll(0, self._zmq.POLLIN):
+            try:
+                got = wire.restricted_loads(self.sock.recv())
+            except wire.WireRejected:
+                self.rejected += 1
+                continue
+            if not (isinstance(got, tuple) and len(got) == 2):
+                self.rejected += 1
+                continue
+            kind, body = got
+            if kind == "dry":
+                rid = int(body.get("rid", -1))
+                if rid in self._outstanding:
+                    self._replies[rid] = None
+                continue
+            if kind != "act" or not isinstance(body, dict):
+                self.rejected += 1
+                continue
+            epoch = int(body.get("epoch", 0))
+            if epoch and epoch < self.epoch_seen:
+                self.stale_epoch += 1
+                continue
+            if epoch:
+                self.epoch_seen = epoch
+            self.last_version = max(self.last_version,
+                                    int(body.get("pv", 0)))
+            rid = int(body.get("rid", -1))
+            if rid not in self._outstanding:
+                continue        # a timed-out request's late reply
+            spans = body.get(obs_spans.SPAN_KEY)
+            if spans:
+                obs_spans.stamp_spans(spans, "infer_reply")
+            self._replies[rid] = body
+
+    # -- observability -------------------------------------------------------
+
+    def gauges(self) -> dict:
+        """Actor-heartbeat gauges: the registry/status/Prometheus view of
+        this worker's remote-policy health."""
+        rt = self.round_trip.snapshot()
+        return {"infer_remote": self.remote_steps,
+                "infer_fallbacks": self.fallbacks,
+                "infer_stale_epoch": self.stale_epoch,
+                "infer_reprobes": self.reprobes,
+                "infer_rt_ms_p50": round(rt["p50_s"] * 1000.0, 3),
+                "infer_rt_ms_p90": round(rt["p90_s"] * 1000.0, 3)}
+
+    def close(self) -> None:
+        self.sock.close(linger=0)

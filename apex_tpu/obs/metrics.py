@@ -105,6 +105,14 @@ def render_fleet(snapshot: dict, prefix: str = "apex") -> tuple[dict, dict]:
         "fleet_peer_chunks_sent": [({"identity": p["identity"]},
                                     p.get("chunks_sent", 0))
                                    for p in snapshot.get("peers", [])],
+        # role-specific serving gauges off the heartbeats (infer server
+        # queue depth / batch percentiles, remote-policy actor fallback
+        # counts) — labeled by peer and gauge name so a new role's
+        # numbers scrape without a code change here
+        "fleet_peer_gauge": [({"identity": p["identity"], "gauge": k}, v)
+                             for p in snapshot.get("peers", [])
+                             for k, v in sorted(
+                                 (p.get("gauges") or {}).items())],
     }
     return gauges, labeled
 

@@ -59,8 +59,14 @@ SPAN_KEY = "obs_spans"
 #: socket -> folded into a pre-sampled batch -> batch handed to the
 #: learner's pull — so frame-age-at-train stays measurable across the
 #: extra network hop (a batch carries the spans of the freshest source
-#: chunks folded into it since the previous sample).
-HOPS = ("sealed", "send", "shard_recv", "shard_sample", "batch_send",
+#: chunks folded into it since the previous sample).  The three infer_*
+#: hops ride POLICY-REQUEST messages on the inference plane
+#: (apex_tpu/infer_service): request shipped by the actor -> coalesced
+#: into a server batch -> reply issued — they precede ``sealed`` because
+#: acting happens before the transition is recorded, and they keep the
+#: extra acting-time network hop visible in the same span vocabulary.
+HOPS = ("infer_send", "infer_batch", "infer_reply",
+        "sealed", "send", "shard_recv", "shard_sample", "batch_send",
         "recv", "merge", "stage", "consume", "prio_wb")
 
 

@@ -46,11 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "replay",
-                            "status", "dqn", "aql", "r2d2", "apex",
-                            "enjoy"],
+                            "infer", "status", "dqn", "aql", "r2d2",
+                            "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator/replay "
                         "(one prioritized-replay shard — see "
-                        "--replay-shards/--shard-id); "
+                        "--replay-shards/--shard-id)/infer (the "
+                        "centralized batched-inference server for "
+                        "--remote-policy actors); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
                         "single-host drivers: dqn/aql/r2d2/apex; "
@@ -126,6 +128,49 @@ def build_parser() -> argparse.ArgumentParser:
                                  or c.replay_snapshot_s),
                    help="seconds between shard snapshots (atomic "
                         "write, quiescent points only); 0 = off")
+    # centralized inference plane (apex_tpu/infer_service): the whole
+    # fleet must agree on the endpoint, so it rides COMMON like the
+    # replay-service flags above
+    p.add_argument("--remote-policy", action="store_true",
+                   default=_env_bool(e.get("APEX_REMOTE_POLICY", "")),
+                   help="actors ship half-group observations to the "
+                        "--role infer server (one batched device "
+                        "dispatch across actor hosts) instead of "
+                        "running the policy locally; the local policy "
+                        "stays as the bit-identical fallback after "
+                        "--infer-wait")
+    p.add_argument("--infer-port", type=int,
+                   default=int(e.get("APEX_INFER_PORT", c.infer_port)))
+    p.add_argument("--infer-ip", default=e.get("APEX_INFER_IP",
+                                               c.infer_ip),
+                   help="host the infer server runs on (env twin "
+                        "APEX_INFER_IP); defaults to localhost")
+    p.add_argument("--infer-batch-max", type=int,
+                   default=int(e.get("APEX_INFER_BATCH_MAX",
+                                     c.infer_batch_max)),
+                   help="max requests coalesced into one scan-stacked "
+                        "dispatch (also the pow2 padding cap)")
+    p.add_argument("--infer-window-ms", type=float,
+                   default=float(e.get("APEX_INFER_WINDOW_MS")
+                                 or c.infer_window_ms),
+                   help="coalesce window opened by the first queued "
+                        "request")
+    p.add_argument("--infer-wait", type=float,
+                   default=float(e.get("APEX_INFER_WAIT")
+                                 or c.infer_wait_s),
+                   help="actor-side reply timeout before the local-"
+                        "policy fallback (a dead server costs this "
+                        "once, then re-probes every --infer-reprobe)")
+    p.add_argument("--infer-reprobe", type=float,
+                   default=float(e.get("APEX_INFER_REPROBE")
+                                 or c.infer_reprobe_s))
+    p.add_argument("--infer-device-params", action="store_true",
+                   default=_env_bool(e.get("APEX_INFER_DEVICE_PARAMS",
+                                           "")),
+                   help="keep the infer server's params device-placed "
+                        "(device_put per publish — the d2d path on a "
+                        "shared-device deployment); skipped on the CPU "
+                        "backend")
     # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
     # and the registry/park state-machine windows — env twins so a whole
     # topology (tests, chaos drills) retunes them without flag plumbing
@@ -243,7 +288,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                               save_interval=args.save_interval,
                               mesh_shape=_mesh_shape(args)),
         actor=ActorConfig(n_actors=args.n_actors,
-                          n_envs_per_actor=args.n_envs_per_actor),
+                          n_envs_per_actor=args.n_envs_per_actor,
+                          remote_policy=args.remote_policy),
         aql=AQLConfig(),
         comms=CommsConfig(batch_port=args.batch_port,
                           param_port=args.param_port,
@@ -257,7 +303,14 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                           replay_port_base=args.replay_port_base,
                           replay_ip=args.replay_ip,
                           replay_strict_order=not args.replay_loose,
-                          replay_snapshot_s=args.replay_snapshot_every),
+                          replay_snapshot_s=args.replay_snapshot_every,
+                          infer_port=args.infer_port,
+                          infer_ip=args.infer_ip,
+                          infer_batch_max=args.infer_batch_max,
+                          infer_window_ms=args.infer_window_ms,
+                          infer_wait_s=args.infer_wait,
+                          infer_reprobe_s=args.infer_reprobe,
+                          infer_device_params=args.infer_device_params),
     )
 
 
@@ -331,6 +384,17 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
         run_replay_shard(cfg, args.shard_id, family=args.family,
                          max_seconds=args.max_seconds,
                          snapshot_dir=args.replay_snapshot_dir)
+    elif args.role == "infer":
+        # the centralized batched-inference server (apex_tpu/
+        # infer_service): binds infer_port, subscribes the learner's
+        # param channel, serves --remote-policy actors until killed /
+        # --max-seconds.  Skips the startup barrier like replay shards —
+        # actors act locally until it answers, so launch order is free.
+        from apex_tpu.infer_service.service import run_infer_server
+        from apex_tpu.runtime.roles import _with_ips
+        cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
+        run_infer_server(cfg, family=args.family,
+                         max_seconds=args.max_seconds)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the

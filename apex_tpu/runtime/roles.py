@@ -241,6 +241,15 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     from apex_tpu.fleet.chaos import maybe_wrap_sender
     from apex_tpu.fleet.park import ParkController
 
+    if getattr(cfg.actor, "remote_policy", False) and family != "dqn":
+        # guard BEFORE the fleet join: failing loud beats a fleet
+        # silently acting on local policies while the operator believes
+        # inference is centralized — and beats burning the barrier
+        # timeout to say so
+        raise NotImplementedError(
+            f"--remote-policy currently serves the dqn family only "
+            f"(got {family!r}) — aql/r2d2 actors stay on local "
+            f"policies (ROADMAP.md)")
     stop_event = stop_event or threading.Event()
     name = f"actor-{identity.actor_id}"
     comms = _with_ips(cfg.comms, identity)
@@ -261,7 +270,10 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
         worker_fn, model_spec = _worker_main, dqn_model_spec(cfg)
-        if cfg.actor.n_envs_per_actor > 1:
+        if cfg.actor.n_envs_per_actor > 1 or cfg.actor.remote_policy:
+            # remote policy lives on the vector family's half-group
+            # hooks, so it forces the vector body even at B=1 (one
+            # group, serial interleave — still one request per step)
             from apex_tpu.actors.vector import vector_worker_main
             worker_fn = vector_worker_main
             # the vector family re-derives its slots' epsilons from the

@@ -888,6 +888,131 @@ def bench_actor_plane() -> dict:
             "pixel": ab(pixel, 16, steps)}
 
 
+# -- part 1e: inference-plane remote/local A/B ------------------------------
+
+INFER_AB_TIMEOUT = float(os.environ.get("BENCH_INFER_AB_TIMEOUT", 300.0))
+
+
+def bench_infer_plane() -> dict:
+    """Part 1e: the vector-actor hot loop with the policy served by the
+    centralized inference plane vs computed locally, same fixed-seed env
+    batch and key chain (remote and local are BIT-IDENTICAL per slot —
+    tests/test_infer.py pins it — so frames/s, round-trip, and coalesce
+    latency are the ONLY things the knob changes).  The server runs
+    in-process on a second thread, which on this 1-core driver box makes
+    remote a pure-plumbing-cost measurement; ``effective_cores`` is
+    recorded like part 1d so a multi-core/TPU run's real batching win
+    stays legible against it."""
+    import socket as socket_lib
+    import threading as threading_lib
+
+    import jax
+    import numpy as np
+
+    from apex_tpu.actors.pool import actor_epsilons
+    from apex_tpu.actors.vector import VectorDQNWorkerFamily
+    from apex_tpu.config import (ActorConfig, ApexConfig, CommsConfig,
+                                 EnvConfig)
+    from apex_tpu.infer_service.client import InferClient
+    from apex_tpu.infer_service.service import InferServer
+    from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.apex import dqn_env_specs
+    from apex_tpu.training.state import create_train_state
+
+    steps = int(os.environ.get("BENCH_INFER_STEPS", 120))
+    warm = 6
+
+    def free_port() -> int:
+        s = socket_lib.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def ab(env_cfg: EnvConfig, n_envs: int, n_steps: int) -> dict:
+        comms = CommsConfig(infer_port=free_port())
+        cfg = ApexConfig(env=env_cfg, comms=comms,
+                         actor=ActorConfig(n_actors=1,
+                                           n_envs_per_actor=n_envs))
+        model_spec, frame_shape, frame_dtype, frame_stack = \
+            dqn_env_specs(cfg)
+        model = DuelingDQN(**model_spec)
+        stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+        ts = create_train_state(model, make_optimizer(), jax.random.key(0),
+                                np.zeros((1,) + stacked, frame_dtype))
+        server = InferServer(comms, make_policy_fn(model), heartbeat=False)
+        server.set_params(1, ts.params)
+        stop = threading_lib.Event()
+        thread = threading_lib.Thread(target=server.run,
+                                      kwargs={"stop_event": stop},
+                                      daemon=True)
+        thread.start()
+
+        out: dict = {"n_envs": n_envs, "vector_steps": n_steps}
+        try:
+            for mode in ("local", "remote"):
+                fam = VectorDQNWorkerFamily(
+                    cfg, model_spec,
+                    seeds=[cfg.env.seed + 1000 * (s + 1)
+                           for s in range(n_envs)],
+                    slot_ids=list(range(n_envs)),
+                    epsilons=actor_epsilons(n_envs), chunk_transitions=64)
+                if mode == "remote":
+                    fam.attach_infer(InferClient(comms, "bench-actor",
+                                                 wait_s=10.0))
+                fam.reset_all()
+                key = jax.random.key(7)
+                for _ in range(warm):
+                    key, k = jax.random.split(key)
+                    fam.step_all(ts.params, k)
+                    fam.poll_msgs()
+                t0 = time.perf_counter()
+                for _ in range(n_steps):
+                    key, k = jax.random.split(key)
+                    fam.step_all(ts.params, k)
+                    fam.poll_msgs()
+                dt = time.perf_counter() - t0
+                out[mode] = {
+                    "frames_per_sec": round(n_steps * n_envs / dt, 1),
+                    "seconds": round(dt, 2)}
+                if mode == "remote":
+                    client = fam.infer
+                    rt = client.round_trip.snapshot()
+                    out[mode] |= {
+                        "remote_steps": client.remote_steps,
+                        "fallbacks": client.fallbacks,
+                        "round_trip_ms": {
+                            "p50": round(rt["p50_s"] * 1000, 3),
+                            "p90": round(rt["p90_s"] * 1000, 3),
+                            "p99": round(rt["p99_s"] * 1000, 3)}}
+                fam.close()
+            b = server.batch_hist.snapshot()
+            c = server.coalesce_hist.snapshot()
+            out["server"] = {
+                "dispatches": server.dispatches,
+                "mean_batch": round(b["mean_s"], 2),
+                "batch_p90": b["p90_s"],
+                "coalesce_ms_p50": round(c["p50_s"] * 1000, 3),
+                "coalesce_ms_p90": round(c["p90_s"] * 1000, 3)}
+            out["speedup"] = (round(out["remote"]["frames_per_sec"]
+                                    / out["local"]["frames_per_sec"], 3)
+                              if out["local"]["frames_per_sec"] else None)
+        finally:
+            stop.set()
+            thread.join(timeout=10)
+            server.close()
+        return out
+
+    toy = EnvConfig(env_id="ApexCartPole-v0", frame_stack=1,
+                    clip_rewards=False, episodic_life=False)
+    pixel = EnvConfig(env_id="ApexCatch-v0", frame_stack=FRAME_STACK,
+                      clip_rewards=False, episodic_life=False)
+    return {"effective_cores": _effective_cores(),
+            "toy": ab(toy, 32, steps),
+            "pixel": ab(pixel, 16, max(10, steps // 4))}
+
+
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
 def _fleet_section(trainer) -> dict | None:
@@ -1095,6 +1220,18 @@ def main() -> None:
             ab = {"error": f"{type(exc).__name__}: {exc}"[:400]}
         with _print_lock:
             RESULT["actor_plane_ab"] = ab
+
+    if os.environ.get("BENCH_SKIP_INFER_AB", "0") != "1":
+        # part 1e: the inference-plane remote/local A/B (frames/s +
+        # round-trip and coalesce percentiles + measured effective_cores,
+        # machine-readable for CI upload and cross-box diffing)
+        _arm("infer_plane_ab", INFER_AB_TIMEOUT)
+        try:
+            iab = bench_infer_plane()
+        except Exception as exc:   # the headline metric survives regardless
+            iab = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["infer_plane_ab"] = iab
 
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU

@@ -28,6 +28,7 @@ while [ $idx -lt ${actors_per_node} ]; do
     "JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
      N_ENVS_PER_ACTOR=${envs_per_actor} LEARNER_IP=${learner_ip} \
      APEX_REPLAY_SHARDS=${replay_shards} REPLAY_IP=${replay_ip} \
+     APEX_REMOTE_POLICY=${remote_policy} APEX_INFER_IP=${infer_ip} \
      /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
        --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
        /opt/apex-env/bin/python -m apex_tpu.runtime \

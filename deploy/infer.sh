@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Infer-host bootstrap (apex_tpu/infer_service — the centralized batched
+# policy server for --remote-policy actors): one supervised process
+# binding infer_port (54001).  The server subscribes the learner's param
+# PUB like any actor (no new publish cycle) and heartbeats into the
+# learner's chunk port, so the fleet registry runs its state machine
+# over it for free; a chaos-killed/crashed server costs the actor fleet
+# one APEX_INFER_WAIT each (local-policy fallback, bit-identical by the
+# parity pin) and the supervised respawn gets its traffic back through
+# the clients' re-probe.
+set -euo pipefail
+command -v git >/dev/null || (apt-get update && apt-get install -y git)
+cd /opt
+git clone ${repo_url} apex-tpu || (cd apex-tpu && git pull)
+cd apex-tpu
+# Baked image (deploy/packer): /opt/apex-env already provisioned; a fresh
+# VM provisions on first boot (idempotence marker makes respawns free).
+[ -f /opt/apex-env/.provisioned-cpu ] || bash deploy/provision.sh cpu
+/opt/apex-env/bin/pip install -e . --no-deps
+
+# On a device-attached host drop JAX_PLATFORMS=cpu and export
+# APEX_INFER_DEVICE_PARAMS=1 so subscribed params stay device-resident
+# (the device-to-device copy path); the CPU default serves correctness
+# and small fleets.
+tmux new -s "infer-0" -d \
+  "JAX_PLATFORMS=cpu APEX_ROLE=infer LEARNER_IP=${learner_ip} \
+   APEX_REMOTE_POLICY=1 \
+   /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+     --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+     /opt/apex-env/bin/python -m apex_tpu.runtime \
+     --env-id ${env_id}; read"

@@ -38,9 +38,27 @@ resource "google_compute_firewall" "apex_ports" {
     ports    = ["51001", "52001", "52002", "52003", "6006"] # 6006: tensorboard
   }
 
-  # apex-replay sources: shard heartbeats ride the learner's chunk port
-  source_tags = ["apex-actor", "apex-evaluator", "apex-replay"]
+  # apex-replay sources: shard heartbeats ride the learner's chunk port;
+  # apex-infer additionally subscribes the param PUB (52001) and beats
+  # on the chunk port like every role
+  source_tags = ["apex-actor", "apex-evaluator", "apex-replay",
+                 "apex-infer"]
   target_tags = ["apex-learner"]
+}
+
+resource "google_compute_firewall" "apex_infer_port" {
+  name    = "apex-tpu-infer-port"
+  network = "default"
+
+  allow {
+    protocol = "tcp"
+    # CommsConfig.infer_port: the infer server's request ROUTER —
+    # remote-policy actors connect their per-worker DEALERs here
+    ports = ["54001"]
+  }
+
+  source_tags = ["apex-actor"]
+  target_tags = ["apex-infer"]
 }
 
 resource "google_compute_firewall" "apex_replay_ports" {
@@ -115,6 +133,10 @@ resource "google_compute_instance" "actor" {
     learner_ip      = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
     replay_shards   = var.replay_shards
     replay_ip       = var.replay_shards > 0 ? "apex-replay" : "127.0.0.1"
+    remote_policy   = var.remote_policy ? 1 : 0
+    # instance NAME like replay_ip above: GCP internal DNS resolves it
+    # inside the VPC, avoiding a terraform IP-reference cycle
+    infer_ip        = var.remote_policy ? "apex-infer" : "127.0.0.1"
   })
 }
 
@@ -147,6 +169,40 @@ resource "google_compute_instance" "replay" {
     env_id        = var.env_id
     replay_shards = var.replay_shards
     learner_ip    = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
+  })
+}
+
+# -- infer host (optional: remote_policy) ----------------------------------
+# The centralized batched-inference plane (apex_tpu/infer_service): one
+# host owns a policy copy and batches the whole actor fleet's half-group
+# requests into scan-stacked device dispatches.  Point it at an
+# accelerator machine type (or co-locate with the learner and set
+# APEX_INFER_DEVICE_PARAMS=1) for the real batching win; actors always
+# keep bit-identical local fallbacks, so losing this host degrades
+# throughput, never correctness.
+
+resource "google_compute_instance" "infer" {
+  count        = var.remote_policy ? 1 : 0
+  name         = "apex-infer"
+  machine_type = var.infer_machine_type
+  tags         = ["apex-infer"]
+
+  boot_disk {
+    initialize_params {
+      image = var.fleet_image
+      size  = 50
+    }
+  }
+
+  network_interface {
+    network = "default"
+    access_config {}
+  }
+
+  metadata_startup_script = templatefile("${path.module}/infer.sh", {
+    repo_url   = var.repo_url
+    env_id     = var.env_id
+    learner_ip = google_tpu_v2_vm.learner.network_endpoints[0].ip_address
   })
 }
 

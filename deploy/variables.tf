@@ -68,6 +68,18 @@ variable "replay_machine_type" {
   description = "Replay host (reference: r5.4xlarge — replay is memory-bound: N shards x capacity frames resident)"
 }
 
+variable "remote_policy" {
+  type        = bool
+  default     = false
+  description = "Centralized batched inference (apex_tpu/infer_service): true launches one infer host binding infer_port (54001) and makes every actor ship half-group observations to it instead of running the policy on its own CPU; actors keep bit-identical local fallbacks, so the host is a throughput upgrade, never a single point of failure."
+}
+
+variable "infer_machine_type" {
+  type        = string
+  default     = "n2-standard-16"
+  description = "Infer host (compute-bound: the whole fleet's policy forwards batch here — use an accelerator machine type for the real win; the CPU default serves small fleets)"
+}
+
 variable "evaluator_machine_type" {
   type    = string
   default = "n2-standard-4"

@@ -45,6 +45,16 @@ mkdir -p "$TRACE_DIR"
 REPLAY_SHARDS="${APEX_REPLAY_SHARDS:-0}"
 export APEX_REPLAY_SHARDS="$REPLAY_SHARDS"
 
+# Centralized inference plane (apex_tpu/infer_service): export
+# APEX_REMOTE_POLICY=1 to launch a `--role infer` policy server and make
+# the actors ship half-group observations to it (one batched device
+# dispatch across actor processes) instead of running the policy on
+# their own CPU.  Every role reads the env twin, so the flag agrees
+# fleet-wide for free; a killed server never stalls actors — they fall
+# back to local policies within APEX_INFER_WAIT and re-probe.
+REMOTE_POLICY="${APEX_REMOTE_POLICY:-0}"
+export APEX_REMOTE_POLICY="$REMOTE_POLICY"
+
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
         --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500
@@ -78,6 +88,14 @@ if [ "$REPLAY_SHARDS" -gt 0 ]; then
     fi
     pids+=($!)
   done
+fi
+
+if [ "$REMOTE_POLICY" = "1" ]; then
+  # the infer server skips the startup barrier (useful the moment its
+  # ROUTER binds); launch before the actors so their first vector steps
+  # already batch centrally instead of burning one fallback wait each
+  python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
+  pids+=($!)
 fi
 
 for i in $(seq 0 $((N_ACTORS - 1))); do

@@ -1048,6 +1048,107 @@ def test_j012_silent_on_variable_and_zero_ports():
         """, "J012")
 
 
+# -- J013: zmq socket touched from two thread-entry methods ------------------
+
+def test_j013_fires_on_socket_shared_by_two_thread_entries():
+    assert fires("""
+        import threading
+        import zmq
+        class Bad:
+            def __init__(self):
+                self.sock = zmq.Context.instance().socket(zmq.ROUTER)
+                self._recv = threading.Thread(target=self._recv_loop)
+                self._acker = threading.Thread(target=self._ack_loop)
+            def _recv_loop(self):
+                while True:
+                    self.sock.recv_multipart()
+            def _ack_loop(self):
+                while True:
+                    self.sock.send(b"ack")
+        """, "J013")
+
+
+def test_j013_fires_through_intra_class_helper_calls():
+    # the touch lives in a helper; both thread entries reach it through
+    # the class-local call graph — still two threads on one socket
+    assert fires("""
+        import threading
+        import zmq
+        class Bad:
+            def __init__(self, ctx):
+                self.sock = ctx.socket(zmq.DEALER)
+                threading.Thread(target=self._a).start()
+                threading.Thread(target=self._b).start()
+            def _flush(self):
+                self.sock.send(b"x")
+            def _a(self):
+                self._flush()
+            def _b(self):
+                self._flush()
+        """, "J013")
+
+
+def test_j013_silent_on_queue_handoff_pattern():
+    # the ChunkReceiver shape: decoders enqueue acks, ONE socket thread
+    # drains the queue and touches the socket — single-owner, clean
+    assert not fires("""
+        import queue
+        import threading
+        import zmq
+        class Good:
+            def __init__(self):
+                self.sock = zmq.Context.instance().socket(zmq.ROUTER)
+                self._ack_q = queue.Queue()
+                self._recv = threading.Thread(target=self._run)
+                self._decoders = [threading.Thread(target=self._decode)
+                                  for _ in range(4)]
+            def _run(self):
+                while True:
+                    self.sock.recv_multipart()
+                    ident = self._ack_q.get_nowait()
+                    self.sock.send_multipart([ident, b"ack"])
+            def _decode(self):
+                while True:
+                    self._ack_q.put(b"peer")
+        """, "J013")
+
+
+def test_j013_silent_on_single_thread_and_main_thread_teardown():
+    # one thread entry owning the socket + main-thread stop()/close() is
+    # the documented migrate-then-use pattern, not a race the rule flags
+    assert not fires("""
+        import threading
+        import zmq
+        class Good:
+            def __init__(self):
+                self.sock = zmq.Context.instance().socket(zmq.REP)
+                self._thread = threading.Thread(target=self._serve)
+            def _serve(self):
+                while True:
+                    self.sock.recv()
+                    self.sock.send(b"ok")
+            def stop(self):
+                self.sock.close(linger=0)
+        """, "J013")
+
+
+def test_j013_silent_on_two_threads_two_sockets():
+    assert not fires("""
+        import threading
+        import zmq
+        class Good:
+            def __init__(self, ctx):
+                self.rx = ctx.socket(zmq.PULL)
+                self.tx = ctx.socket(zmq.PUSH)
+                threading.Thread(target=self._rx_loop).start()
+                threading.Thread(target=self._tx_loop).start()
+            def _rx_loop(self):
+                self.rx.recv()
+            def _tx_loop(self):
+                self.tx.send(b"x")
+        """, "J013")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

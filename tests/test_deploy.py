@@ -126,6 +126,32 @@ def test_replay_firewall_range_matches_comms_config():
     assert "apex-replay" in learner_src
 
 
+def test_infer_firewall_and_heartbeat_path_match_comms_config():
+    """The infer-host rule must open exactly CommsConfig.infer_port with
+    actors as the source (their per-worker DEALERs connect there) — and
+    the return paths to the learner (param SUB on 52001, heartbeats on
+    the chunk port) must include apex-infer as a source."""
+    from apex_tpu.config import CommsConfig
+
+    main = (DEPLOY / "main.tf").read_text()
+    m = re.search(
+        r'"apex_infer_port"(.*?)target_tags\s*=\s*\[([^\]]*)\]',
+        main, re.DOTALL)
+    assert m, "no apex_infer_port firewall resource"
+    body, targets = m.group(1), m.group(2)
+    ports = {int(p) for p in re.findall(r'"(\d+)"', body)}
+    assert CommsConfig().infer_port in ports
+    assert "apex-infer" in targets
+    src = re.search(r'source_tags\s*=\s*\[([^\]]*)\]', body).group(1)
+    assert "apex-actor" in src
+    learner_rule = re.search(
+        r'"apex_ports"(.*?)target_tags\s*=\s*\[([^\]]*)\]',
+        main, re.DOTALL).group(1)
+    learner_src = re.search(r'source_tags\s*=\s*\[([^\]]*)\]',
+                            learner_rule).group(1)
+    assert "apex-infer" in learner_src
+
+
 def test_provisioning_is_pinned_and_idempotent():
     """The Packer-analogue (VERDICT r4 item 7; reference:
     origin_repo/deploy/packer/ape_x_cpu.sh): one parametrized provision
@@ -175,7 +201,8 @@ def test_role_scripts_use_baked_env():
     (baked image or first-boot fallback) — an unpinned system python is
     exactly the version skew the bake exists to kill."""
     for name, flavor in (("actor.sh", "cpu"), ("evaluator.sh", "cpu"),
-                         ("replay.sh", "cpu"), ("learner.sh", "tpu")):
+                         ("replay.sh", "cpu"), ("infer.sh", "cpu"),
+                         ("learner.sh", "tpu")):
         text = (DEPLOY / name).read_text()
         assert f"provision.sh {flavor}" in text, \
             f"{name}: no first-boot provisioning fallback"
@@ -214,8 +241,8 @@ def test_fleet_image_variable_wired():
     startup script."""
     main, declared, _ = _main_and_vars()
     assert "fleet_image" in declared
-    # actors + evaluator + replay host
-    assert main.count("image = var.fleet_image") == 3
+    # actors + evaluator + replay host + infer host
+    assert main.count("image = var.fleet_image") == 4
 
 
 def test_validate_binaries_if_available():
@@ -247,7 +274,7 @@ def test_bootstrap_scripts_use_host_supervisor():
     ActorPool respawn semantics for whole processes), which pairs with
     the roles' park/rejoin path.  The old inline ``while true`` loops
     must stay gone: they had no budget window and no jitter."""
-    for name in ("actor.sh", "evaluator.sh", "replay.sh"):
+    for name in ("actor.sh", "evaluator.sh", "replay.sh", "infer.sh"):
         text = (DEPLOY / name).read_text()
         assert "apex_tpu.fleet.supervise" in text, \
             f"{name}: role not launched under the host supervisor"

@@ -1,0 +1,536 @@
+"""Centralized batched inference plane (apex_tpu/infer_service).
+
+The acceptance anchor is bit-parity: for identical params and key
+chains, remote-served actions/chunks/priorities must be BIT-IDENTICAL to
+the local-policy path — for even and odd B (uneven half-groups), through
+real sockets, and through the local fallback (which makes a dead server
+a scheduling event, never a trajectory fork).
+"""
+
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.actors.pool import actor_epsilons
+from apex_tpu.actors.vector import VectorDQNWorkerFamily
+from apex_tpu.config import CommsConfig, small_test_config
+from apex_tpu.infer_service import (InferClient, InferServer,
+                                    quantize_pow2)
+from apex_tpu.infer_service.service import make_batched_policy
+from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.runtime import wire
+from apex_tpu.training.apex import dqn_env_specs
+from apex_tpu.training.state import create_train_state
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cfg(**comms_kw):
+    cfg = small_test_config()
+    return cfg.replace(comms=CommsConfig(infer_port=_free_port(),
+                                         **comms_kw))
+
+
+def _params(cfg, model_spec):
+    _, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+    model = DuelingDQN(**model_spec)
+    ts = create_train_state(model, make_optimizer(), jax.random.key(0),
+                            np.zeros((1,) + stacked, frame_dtype))
+    return model, ts.params
+
+
+def _serve(cfg, model, params, version=3, epoch=0):
+    """A live InferServer on a background thread (tests drive params
+    directly — the subscriber path is the same set_params call)."""
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    if params is not None:
+        server.set_params(version, params, epoch=epoch)
+    stop = threading.Event()
+    t = threading.Thread(target=server.run, kwargs={"stop_event": stop},
+                         daemon=True)
+    t.start()
+    return server, stop, t
+
+
+def _drive(fam, params, n_steps, seed=1):
+    """Fixed key chain through n_steps vector steps; returns
+    (stats, chunk messages incl. flush) — the test_vector contract."""
+    fam.reset_all()
+    key = jax.random.key(seed)
+    stats, msgs = [], []
+    for _ in range(n_steps):
+        key, k = jax.random.split(key)
+        stats.extend(fam.step_all(params, k))
+        msgs.extend(fam.poll_msgs())
+    msgs.extend(m for b in fam.builders
+                for m in ({"payload": c, "priorities": c.pop("priorities"),
+                           "n_trans": int(c["n_trans"])}
+                          for c in b.force_flush()))
+    fam.close()
+    return stats, msgs
+
+
+def _family(cfg, model_spec, n_envs):
+    return VectorDQNWorkerFamily(
+        cfg, model_spec, seeds=[100 + i for i in range(n_envs)],
+        slot_ids=list(range(n_envs)), epsilons=actor_epsilons(n_envs),
+        chunk_transitions=16)
+
+
+def _chunk_msgs_equal(a, b):
+    assert len(a) == len(b)
+    for ma, mb in zip(a, b):
+        assert ma["n_trans"] == mb["n_trans"]
+        np.testing.assert_array_equal(ma["priorities"], mb["priorities"])
+        pa, pb = ma["payload"], mb["payload"]
+        assert set(pa) == set(pb)
+        for k in pa:
+            np.testing.assert_array_equal(np.asarray(pa[k]),
+                                          np.asarray(pb[k]),
+                                          err_msg=f"payload[{k}] diverged")
+
+
+# -- pow2 batch quantization -------------------------------------------------
+
+def test_quantize_pow2_pins():
+    assert [quantize_pow2(n, 16) for n in (1, 2, 3, 4, 5, 8, 9, 16, 40)] \
+        == [1, 2, 4, 4, 8, 8, 16, 16, 16]
+    assert quantize_pow2(7, 4) == 4          # cap wins
+    assert quantize_pow2(0, 16) == 1         # degenerate floor
+
+
+# -- the acceptance pin: cross-wire bit-parity -------------------------------
+
+@pytest.mark.parametrize("n_envs", [2, 5])
+def test_remote_policy_bit_identical_to_local(n_envs):
+    """Remote-served acting equals local acting bit for bit — actions
+    (via the recorded transitions), sealed chunks, and priorities — for
+    even and odd B (uneven half-groups exercise BOTH group shapes on the
+    server), through real sockets.  Every remote step must actually be
+    remote (zero fallbacks), or the pin would pass vacuously."""
+    cfg = _cfg()
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    server, stop, t = _serve(cfg, model, params)
+    try:
+        local = _family(cfg, model_spec, n_envs)
+        stats_l, msgs_l = _drive(local, params, 120)
+
+        remote = _family(cfg, model_spec, n_envs)
+        remote.attach_infer(InferClient(cfg.comms, "actor-0", wait_s=30.0))
+        client = remote.infer
+        stats_r, msgs_r = _drive(remote, params, 120)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+
+    assert client.remote_steps > 0 and client.fallbacks == 0, \
+        (client.remote_steps, client.fallbacks)
+    assert stats_l, "no episodes ended: the pin never exercised resets"
+    assert [(s.actor_id, s.reward, s.length) for s in stats_l] \
+        == [(s.actor_id, s.reward, s.length) for s in stats_r]
+    _chunk_msgs_equal(msgs_l, msgs_r)
+    # both half-groups went remote: group ids 0 and 1 each served
+    assert server.requests == client.remote_steps
+    assert server.dispatches > 0
+
+
+def test_fallback_on_timeout_is_bit_identical_and_bounded():
+    """No server at all: every step falls back to the local policy after
+    infer_wait_s — trajectories identical to pure-local acting (the
+    fallback IS the local program), and the down-marker means the wait is
+    paid once, not per step."""
+    cfg = _cfg()
+    model_spec, *_ = dqn_env_specs(cfg)
+    _, params = _params(cfg, model_spec)
+
+    local = _family(cfg, model_spec, 3)
+    stats_l, msgs_l = _drive(local, params, 60)
+
+    remote = _family(cfg, model_spec, 3)
+    remote.attach_infer(InferClient(cfg.comms, "actor-0", wait_s=0.3,
+                                    reprobe_s=60.0))
+    client = remote.infer
+    t0 = time.monotonic()
+    stats_r, msgs_r = _drive(remote, params, 60)
+    elapsed = time.monotonic() - t0
+
+    assert client.remote_steps == 0 and client.fallbacks == 120
+    # the down-marker: only the first submit(s) paid the wire wait (both
+    # half-group requests of the first step were already in flight when
+    # the first timeout landed), everything after ran local-immediate
+    assert elapsed < 30.0, f"fallback path stalled the loop: {elapsed:.1f}s"
+    assert [(s.actor_id, s.reward, s.length) for s in stats_l] \
+        == [(s.actor_id, s.reward, s.length) for s in stats_r]
+    _chunk_msgs_equal(msgs_l, msgs_r)
+
+
+def test_reprobe_regains_traffic_after_respawn():
+    """The PR 8 re-probe discipline, applied to the infer server: a
+    client that marked the server down keeps probing every reprobe_s, so
+    a (re)spawned server gets its traffic back with no actor restart —
+    and the probe traffic is bit-transparent either way."""
+    cfg = _cfg()
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+
+    fam = _family(cfg, model_spec, 2)
+    fam.attach_infer(InferClient(cfg.comms, "actor-0", wait_s=0.5,
+                                 reprobe_s=0.3))
+    client = fam.infer
+    fam.reset_all()
+    key = jax.random.key(1)
+
+    # phase 1: no server — fall back, mark down
+    for _ in range(3):
+        key, k = jax.random.split(key)
+        fam.step_all(params, k)
+    assert client.fallbacks > 0 and client.remote_steps == 0
+
+    # phase 2: the server comes up; the next probe re-attaches
+    server, stop, t = _serve(cfg, model, params)
+    try:
+        deadline = time.monotonic() + 30.0
+        while client.remote_steps == 0 and time.monotonic() < deadline:
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+            time.sleep(0.05)
+    finally:
+        fam.close()
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+    assert client.remote_steps > 0, "re-probe never regained the server"
+    assert client.reprobes > 0
+
+
+def test_dry_reply_before_params_falls_back_immediately():
+    """A server without params answers ("dry", rid) so the client acts
+    locally NOW instead of burning the full timeout per step."""
+    cfg = _cfg()
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    server, stop, t = _serve(cfg, model, params=None)   # no params yet
+    try:
+        fam = _family(cfg, model_spec, 2)
+        fam.attach_infer(InferClient(cfg.comms, "actor-0", wait_s=20.0,
+                                     reprobe_s=0.0))
+        client = fam.infer
+        fam.reset_all()
+        key = jax.random.key(1)
+        t0 = time.monotonic()
+        for _ in range(4):
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+        elapsed = time.monotonic() - t0
+        assert client.fallbacks == 8 and client.remote_steps == 0
+        assert elapsed < 10.0, \
+            f"dry replies should beat the 20s timeout ({elapsed:.1f}s)"
+        assert server.dry_replies >= 8
+        # params arrive: the same fleet goes remote with no reconnect
+        server.set_params(1, params)
+        deadline = time.monotonic() + 30.0
+        while client.remote_steps == 0 and time.monotonic() < deadline:
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+        assert client.remote_steps > 0
+        fam.close()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+
+
+# -- epoch fencing -----------------------------------------------------------
+
+def test_stale_epoch_reply_discarded():
+    """A reply stamped with an OLDER learner epoch than the newest seen
+    is a dead life's straggler: counted, discarded, and the step falls
+    back to the local policy (PR 8 fencing on the inference plane)."""
+    import zmq
+
+    port = _free_port()
+    comms = CommsConfig(infer_port=port)
+    router = zmq.Context.instance().socket(zmq.ROUTER)
+    router.bind(f"tcp://127.0.0.1:{port}")
+    client = InferClient(comms, "actor-0", wait_s=1.0)
+    try:
+        obs = np.zeros((2, 4), np.float32)
+        eps = np.zeros(2, np.float32)
+        fb = lambda: (np.full(2, 7, np.int64), np.zeros((2, 3),
+                                                        np.float32))
+
+        def roundtrip(epoch):
+            pend = client.submit(obs, eps, jax.random.key(0), 0, fb)
+            ident, payload = router.recv_multipart()
+            got = wire.restricted_loads(payload)
+            rid = got[1]["rid"]
+            router.send_multipart([ident, wire.dumps(("act", {
+                "rid": rid, "actions": np.zeros(2, np.int64),
+                "q": np.ones((2, 3), np.float32), "pv": 1,
+                "epoch": epoch}))])
+            return pend.materialize()
+
+        a1, _ = roundtrip(epoch=5)          # fresh epoch: accepted
+        assert client.epoch_seen == 5 and client.remote_steps == 1
+        np.testing.assert_array_equal(a1, np.zeros(2, np.int64))
+
+        a2, _ = roundtrip(epoch=3)          # stale: discarded -> fallback
+        assert client.stale_epoch == 1
+        assert client.fallbacks == 1
+        np.testing.assert_array_equal(a2, np.full(2, 7, np.int64))
+    finally:
+        client.close()
+        router.close(linger=0)
+
+
+# -- hostile payloads --------------------------------------------------------
+
+def test_hostile_payload_rejected_on_infer_router():
+    """A payload outside the wire allowlist is counted and dropped with
+    NO reply — the hostile sender eats its own fallback wait; a
+    well-formed request right behind it is served normally."""
+    import pickle
+
+    import zmq
+
+    cfg = _cfg()
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    server.set_params(1, params)
+    hostile = zmq.Context.instance().socket(zmq.DEALER)
+    hostile.setsockopt(zmq.IDENTITY, b"hostile")
+    hostile.connect(f"tcp://127.0.0.1:{cfg.comms.infer_port}")
+    client = InferClient(cfg.comms, "actor-0", wait_s=10.0)
+    try:
+        hostile.send(pickle.dumps(("infer", Evil())))
+        hostile.send(pickle.dumps("not even a tuple"))
+        _, frame_shape, *_ = dqn_env_specs(cfg)
+        obs = np.zeros((2,) + frame_shape, np.float32)
+        pend = client.submit(obs, np.zeros(2, np.float32),
+                             jax.random.key(0), 0,
+                             lambda: (np.zeros(2, np.int64),
+                                      np.zeros((2, 2), np.float32)))
+        deadline = time.monotonic() + 30.0
+        served = 0
+        while served == 0 and time.monotonic() < deadline:
+            served = server.step(timeout_ms=100)
+        actions, q = pend.materialize()
+        assert client.remote_steps == 1 and client.fallbacks == 0
+        assert server.rejected == 2
+        assert q.shape[0] == 2
+        assert not hostile.poll(200, zmq.POLLIN), \
+            "hostile sender must not receive a reply (no earned credit)"
+    finally:
+        client.close()
+        hostile.close(linger=0)
+        server.close()
+
+
+# -- batch coalescing --------------------------------------------------------
+
+def test_coalesce_batches_across_clients_and_pads_deterministically():
+    """Requests from DIFFERENT clients queued together serve as ONE
+    scan-stacked dispatch (padded to the pow2 width), and each reply is
+    bit-identical to what a lone dispatch of that request returns — the
+    batching is invisible to results, visible only to throughput."""
+    cfg = _cfg()
+    model_spec, frame_shape, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    policy = make_policy_fn(model)
+    server = InferServer(cfg.comms, policy, heartbeat=False)
+    server.set_params(1, params)
+    clients = [InferClient(cfg.comms, f"actor-{i}", wait_s=30.0)
+               for i in range(3)]
+    try:
+        rng = np.random.default_rng(0)
+        reqs, pends = [], []
+        for i, c in enumerate(clients):
+            obs = rng.standard_normal((2,) + frame_shape).astype(
+                np.float32)
+            eps = rng.random(2).astype(np.float32)
+            key, group = jax.random.key(50 + i), i % 2
+            reqs.append((obs, eps, key, group))
+            pends.append(c.submit(obs, eps, key, group,
+                                  lambda: (None, None)))
+        time.sleep(0.2)                   # let all three hit the socket
+        served = server.step(timeout_ms=1000)
+        assert served == 3
+        assert server.dispatches == 1, "3 queued requests -> ONE dispatch"
+        assert server.batch_hist.max == 3.0
+
+        # bit-parity vs a direct single-request evaluation of the same
+        # program (fold_in(key, group), exactly the actor-local math)
+        lone = jax.jit(policy)
+        for (obs, eps, key, group), pend in zip(reqs, pends):
+            actions, q = pend.materialize()
+            want_a, want_q = lone(
+                params, obs, eps, jax.random.fold_in(key, group))
+            np.testing.assert_array_equal(actions, np.asarray(want_a))
+            np.testing.assert_array_equal(q, np.asarray(want_q))
+        for c in clients:
+            assert c.remote_steps == 1 and c.fallbacks == 0
+    finally:
+        for c in clients:
+            c.close()
+        server.close()
+
+
+def test_scan_batching_matches_unbatched_program():
+    """The scan-of-identical-bodies contract at the numeric level: the
+    server's padded scan produces bit-identical rows to one-at-a-time
+    evaluation, for mixed groups and a non-pow2 request count."""
+    cfg = _cfg()
+    model_spec, frame_shape, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    policy = make_policy_fn(model)
+    batched = make_batched_policy(policy)
+    lone = jax.jit(policy)
+
+    rng = np.random.default_rng(1)
+    n, width = 5, quantize_pow2(5, 16)
+    obs = rng.standard_normal((n, 3) + frame_shape).astype(np.float32)
+    eps = rng.random((n, 3)).astype(np.float32)
+    keys = [jax.random.key(10 + i) for i in range(n)]
+    groups = np.asarray([0, 1, 0, 1, 0], np.int32)
+    idx = list(range(n)) + [n - 1] * (width - n)
+    a, q = batched(params, obs[idx],
+                   eps[idx],
+                   np.stack([np.asarray(jax.random.key_data(keys[i]))
+                             for i in idx]),
+                   groups[idx])
+    for i in range(n):
+        want_a, want_q = lone(params, obs[i], eps[i],
+                              jax.random.fold_in(keys[i],
+                                                 int(groups[i])))
+        np.testing.assert_array_equal(np.asarray(a)[i],
+                                      np.asarray(want_a))
+        np.testing.assert_array_equal(np.asarray(q)[i],
+                                      np.asarray(want_q))
+
+
+# -- observability: gauges on the status surface -----------------------------
+
+def test_heartbeat_gauges_flow_to_registry_status_and_prometheus():
+    """The infer role's serving gauges (and remote-policy actors'
+    fallback counts) ride ordinary heartbeats into the registry, the
+    `--role status` table, and the Prometheus exposition — the new role
+    is not a blind spot on day one."""
+    from apex_tpu.fleet.heartbeat import Heartbeat
+    from apex_tpu.fleet.registry import FleetRegistry, format_fleet_table
+    from apex_tpu.obs import metrics as obs_metrics
+
+    reg = FleetRegistry(CommsConfig())
+    reg.observe(Heartbeat(identity="infer-0", role="infer",
+                          gauges={"queue_depth": 3, "batch_p50": 2.0,
+                                  "batch_p90": 4.0}))
+    reg.observe(Heartbeat(identity="actor-0", role="actor",
+                          gauges={"infer_fallbacks": 7,
+                                  "infer_rt_ms_p50": 1.5}))
+    snap = reg.snapshot()
+    by_id = {p["identity"]: p for p in snap["peers"]}
+    assert by_id["infer-0"]["gauges"]["queue_depth"] == 3
+    assert by_id["actor-0"]["gauges"]["infer_fallbacks"] == 7
+
+    table = format_fleet_table(snap)
+    assert "infer-0: " in table and "batch_p50=2.0" in table
+    assert "infer_fallbacks=7" in table
+
+    gauges, labeled = obs_metrics.render_fleet(snap)
+    rows = {(lab["identity"], lab["gauge"]): v
+            for lab, v in labeled["fleet_peer_gauge"]}
+    assert rows[("infer-0", "queue_depth")] == 3
+    assert rows[("actor-0", "infer_fallbacks")] == 7
+    text = obs_metrics.render(gauges=gauges, labeled=labeled)
+    assert 'apex_fleet_peer_gauge{gauge="queue_depth",' \
+           'identity="infer-0"} 3' in text
+
+
+def test_heartbeat_gauges_survive_the_restricted_wire():
+    """gauges is a plain dict of builtins, so the allowlisted unpickler
+    carries it unchanged (the field must never force an allowlist
+    growth)."""
+    from apex_tpu.fleet.heartbeat import Heartbeat
+
+    hb = Heartbeat(identity="infer-0", role="infer",
+                   gauges={"queue_depth": 2, "batch_p50": 1.5})
+    got = wire.restricted_loads(wire.dumps(hb))
+    assert got.gauges == {"queue_depth": 2, "batch_p50": 1.5}
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_infer_flags_and_env_twins(monkeypatch):
+    from apex_tpu.runtime.cli import build_parser, config_from_args
+
+    monkeypatch.setenv("APEX_REMOTE_POLICY", "1")
+    monkeypatch.setenv("APEX_INFER_PORT", "54321")
+    monkeypatch.setenv("APEX_INFER_IP", "10.4.4.4")
+    monkeypatch.setenv("APEX_INFER_BATCH_MAX", "8")
+    monkeypatch.setenv("APEX_INFER_WINDOW_MS", "3.5")
+    monkeypatch.setenv("APEX_INFER_WAIT", "0.25")
+    monkeypatch.setenv("APEX_INFER_REPROBE", "2.5")
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.actor.remote_policy is True
+    assert cfg.comms.infer_port == 54321
+    assert cfg.comms.infer_ip == "10.4.4.4"
+    assert cfg.comms.infer_batch_max == 8
+    assert cfg.comms.infer_window_ms == 3.5
+    assert cfg.comms.infer_wait_s == 0.25
+    assert cfg.comms.infer_reprobe_s == 2.5
+    # the infer role parses and dispatches (guard: dqn-only)
+    args2 = build_parser().parse_args(["--role", "infer",
+                                       "--family", "aql"])
+    from apex_tpu.infer_service.service import run_infer_server
+    with pytest.raises(NotImplementedError, match="dqn"):
+        run_infer_server(config_from_args(args2), family="aql")
+
+
+def test_remote_policy_guards():
+    """Non-vector families refuse attach (loud beats silently local),
+    and the aql/r2d2 socket roles refuse --remote-policy outright."""
+    import dataclasses
+
+    from apex_tpu.actors.vector import VectorFamilyBase
+    from apex_tpu.config import RoleIdentity
+    from apex_tpu.runtime.roles import run_actor
+
+    class NoRemote(VectorFamilyBase):
+        def _make_env(self, seed):
+            from apex_tpu.envs.registry import make_env
+            return make_env("ApexCartPole-v0", small_test_config().env,
+                            seed=seed)
+
+        def _on_reset(self, i, obs):
+            pass
+
+    fam = NoRemote(small_test_config(), [1], [0], [0.4])
+    with pytest.raises(NotImplementedError, match="remote"):
+        fam.attach_infer(object())
+    fam.close()
+
+    cfg = small_test_config()
+    cfg = cfg.replace(actor=dataclasses.replace(cfg.actor,
+                                                remote_policy=True))
+    with pytest.raises(NotImplementedError, match="dqn"):
+        run_actor(cfg, RoleIdentity(role="actor"), family="aql")
