@@ -1042,3 +1042,125 @@ class JitInLoop(Rule):
                     "jax.jit called inside a loop body — hoist it; each "
                     "call builds a new wrapper and retraces"))
         return out
+
+
+# -- J014 -------------------------------------------------------------------
+
+
+@register
+class HostNumpyOpInScannedEnv(Rule):
+    id = "J014"
+    name = "host-numpy-op-in-scanned-env"
+    description = ("np.* / float() / .item() reachable from a function "
+                   "passed to lax.scan (a scanned env/rollout body, "
+                   "training/anakin.py discipline): host numpy executes at "
+                   "TRACE time — a TracerError at best, a silently frozen "
+                   "per-compile constant at worst.  Use jnp ops inside the "
+                   "compiled rollout; hoist genuine host work out of the "
+                   "scan")
+
+    _BUILTINS = {"float", "int", "bool"}
+
+    def _scanned_functions(self, ctx: ModuleContext) -> set:
+        """FunctionDefs reachable from a ``lax.scan``/``jax.lax.scan``
+        body argument: named callees, every call inside an inline lambda
+        body, nested defs, and the transitive same-module call graph
+        (the jitted-scope closure's discipline, re-rooted at scan)."""
+        seeds: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "scan"
+                    and _attr_root(f) in ("lax", "jax")):
+                continue
+            tgt = node.args[0]
+            if isinstance(tgt, ast.Name):
+                seeds.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                seeds.add(tgt.attr)
+            elif isinstance(tgt, ast.Lambda):
+                # `lambda c, x: self._step(...)` — everything the lambda
+                # calls runs inside the scanned program
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Call):
+                        nm = call_name(sub)
+                        if nm:
+                            seeds.add(nm)
+        if not seeds:
+            return set()
+        scanned = {fn for fn in ctx.functions if fn.name in seeds}
+        by_name: dict[str, list] = {}
+        for fn in ctx.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(scanned):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for cand in by_name.get(call_name(node) or "", []):
+                        if cand not in scanned:
+                            scanned.add(cand)
+                            changed = True
+        return scanned
+
+    @staticmethod
+    def _static_arg(a: ast.AST) -> bool:
+        """Constants, attribute chains (``self.B`` — static config), and
+        tuples thereof: legitimate trace-time shape/constant construction
+        (``np.prod(self.frame_shape)``), not traced data."""
+        if isinstance(a, ast.Constant):
+            return True
+        if isinstance(a, ast.Attribute):
+            return _attr_root(a) is not None
+        if isinstance(a, (ast.Tuple, ast.List)):
+            return all(HostNumpyOpInScannedEnv._static_arg(e)
+                       for e in a.elts)
+        if isinstance(a, ast.UnaryOp):
+            return HostNumpyOpInScannedEnv._static_arg(a.operand)
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        scanned = self._scanned_functions(ctx)
+        out = []
+        for fn in scanned:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                sub = ctx.enclosing_function(node)
+                # nested defs inside a scanned fn are scanned too; a
+                # node inside some OTHER nested non-scanned def is not
+                # reachable this way unless the closure marked it
+                while sub is not None and sub is not fn:
+                    if sub in scanned:
+                        break
+                    sub = ctx.enclosing_function(sub)
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and _attr_root(f) in _NUMPY_ALIASES
+                        and not all(self._static_arg(a)
+                                    for a in node.args)):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"np.{f.attr}() in '{fn.name}', a lax.scan-scanned "
+                        f"body — host numpy runs at trace time; use "
+                        f"jnp.{f.attr} inside the compiled rollout"))
+                elif (isinstance(f, ast.Name) and f.id in self._BUILTINS
+                        and node.args
+                        and not all(isinstance(a, ast.Constant)
+                                    for a in node.args)):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"{f.id}() in '{fn.name}', a lax.scan-scanned "
+                        f"body — pulls a traced value to host; keep it a "
+                        f"traced array"))
+                elif (isinstance(f, ast.Attribute) and f.attr == "item"
+                        and not node.args):
+                    out.append(ctx.finding(
+                        self, node,
+                        f".item() in '{fn.name}', a lax.scan-scanned "
+                        f"body — pulls a traced value to host; keep it a "
+                        f"traced array"))
+        return out

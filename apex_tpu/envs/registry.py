@@ -128,6 +128,48 @@ def make_env(env_id: str | None = None, cfg: EnvConfig | None = None,
     return env
 
 
+def jittable_env(env_id: str) -> bool:
+    """Capability flag: True when :func:`make_jax_env` can build a pure-JAX
+    port of ``env_id`` for on-device Anakin rollouts
+    (:mod:`apex_tpu.training.anakin`).  Catch/Rally are integer/float32
+    grid worlds that run inside the accelerator; everything else (ALE,
+    CartPole-family float dynamics, continuous nav) stays on the host
+    pipeline."""
+    return env_id.startswith(("ApexCatch", "ApexRally"))
+
+
+def make_jax_env(env_id: str | None = None, cfg: EnvConfig | None = None):
+    """Jittable functional twin of :func:`make_env` for the on-device
+    rollout engine — same env-id -> variant-geometry table as the numpy
+    dispatch above, returning an :class:`apex_tpu.envs.jax_envs.JaxEnv`
+    (pure reset/step over array states, auto-reset inside step).  Raises
+    ``ValueError`` naming the env id for non-jittable envs — the
+    ``--rollout ondevice`` / ``--role loadgen`` guard."""
+    from apex_tpu.envs import jax_envs
+
+    cfg = cfg or EnvConfig()
+    env_id = env_id or cfg.env_id
+    if not jittable_env(env_id):
+        raise ValueError(
+            f"env {env_id!r} has no jittable port — on-device rollouts "
+            f"(--rollout ondevice / --role loadgen) serve the "
+            f"ApexCatch*/ApexRally* families only; use the host actor "
+            f"pipeline for this env")
+    if env_id.startswith("ApexCatch"):
+        if "Small" in env_id:
+            return jax_envs.make_catch(grid=7, pixels=42, balls=3,
+                                       env_id=env_id)
+        if "Medium" in env_id:
+            return jax_envs.make_catch(grid=11, pixels=44, balls=4,
+                                       env_id=env_id)
+        return jax_envs.make_catch(env_id=env_id)
+    if "Small" in env_id:
+        return jax_envs.make_rally(grid=14, pixels=42, points=2,
+                                   agent_half=2, opp_speed=0.45,
+                                   env_id=env_id)
+    return jax_envs.make_rally(env_id=env_id)
+
+
 def unstacked_env_spec(env: gym.Env,
                        cfg: EnvConfig) -> tuple[tuple[int, ...], Any, int]:
     """(frame_shape, frame_dtype, frame_stack) for an env built with

@@ -160,7 +160,7 @@ class RallyEnv(gym.Env):
 
     def __init__(self, grid: int = 21, pixels: int = 84, points: int = 3,
                  paddle_half: int = 1, agent_half: int | None = None,
-                 opp_speed: float = 1.0):
+                 opp_speed: float = 1.0, dtype=np.float64):
         # ``agent_half`` widens ONLY the agent's paddle (easier receiving
         # without making the opponent harder to score past) and
         # ``opp_speed`` caps the opponent's per-step tracking — the two
@@ -170,6 +170,14 @@ class RallyEnv(gym.Env):
         self.half = paddle_half
         self.agent_half = self.half if agent_half is None else agent_half
         self.opp_speed = opp_speed
+        # Continuous-state compute dtype.  float64 (the python-float
+        # default) is bit-identical to the pre-knob behavior; float32
+        # makes every op the same correctly-rounded IEEE-f32 op the
+        # jittable port (envs/jax_envs.py) runs, so the exact-trajectory
+        # parity pin can compare like with like — the deflection lattice
+        # is non-dyadic (7/12ths), so f64 and f32 trajectories disagree
+        # at round()-to-pixel boundaries after a few paddle contacts.
+        self._scalar = np.dtype(dtype).type
         self.observation_space = gym.spaces.Box(0, 255, (pixels, pixels, 1),
                                                 np.uint8)
         self.action_space = gym.spaces.Discrete(3)
@@ -179,16 +187,16 @@ class RallyEnv(gym.Env):
 
     def reset(self, *, seed=None, options=None):
         super().reset(seed=seed)
-        self._agent_y = self._opp_y = (self.grid - 1) / 2
+        self._agent_y = self._opp_y = self._scalar((self.grid - 1) / 2)
         self._played = 0
         self._serve(toward_agent=bool(self.np_random.random() < 0.5))
         return self._render(), {}
 
     def _serve(self, toward_agent: bool) -> None:
-        self._bx = (self.grid - 1) / 2
-        self._by = float(self.np_random.integers(2, self.grid - 2))
+        self._bx = self._scalar((self.grid - 1) / 2)
+        self._by = self._scalar(self.np_random.integers(2, self.grid - 2))
         self._vx = 1 if toward_agent else -1
-        self._vy = float(self.np_random.choice([-1.0, -0.5, 0.5, 1.0]))
+        self._vy = self._scalar(self.np_random.choice([-1.0, -0.5, 0.5, 1.0]))
 
     def _deflect(self, offset: float) -> float:
         """Paddle-contact vertical speed from the normalized hit offset
@@ -197,18 +205,18 @@ class RallyEnv(gym.Env):
         if abs(vy) < self.MIN_VY:
             sign = 1.0 if self.np_random.random() < 0.5 else -1.0
             vy = self.MIN_VY * sign
-        return float(np.clip(vy, -self.MAX_VY, self.MAX_VY))
+        return self._scalar(np.clip(vy, -self.MAX_VY, self.MAX_VY))
 
     def step(self, action):
         g, half, ahalf = self.grid, self.half, self.agent_half
         # agent paddle
-        self._agent_y = float(np.clip(
+        self._agent_y = self._scalar(np.clip(
             self._agent_y + (0, -1, 1)[int(action)], ahalf, g - 1 - ahalf))
         # scripted opponent: track the ball at ALL times (a re-centering
         # opponent loses to plain tracking — measured; this one only
         # loses to deliberately generated steep angles, or — at reduced
         # opp_speed — to sustained accurate returns)
-        self._opp_y = float(np.clip(
+        self._opp_y = self._scalar(np.clip(
             self._opp_y + np.clip(self._by - self._opp_y,
                                   -self.opp_speed, self.opp_speed),
             half, g - 1 - half))
@@ -225,7 +233,7 @@ class RallyEnv(gym.Env):
         reward = 0.0
         if self._bx <= 0:                       # opponent's goal column
             if abs(self._by - self._opp_y) <= half + 0.5:
-                self._bx, self._vx = 0.0, 1
+                self._bx, self._vx = self._scalar(0.0), 1
                 self._vy = self._deflect(
                     (self._by - self._opp_y) / (half + 0.5))
             else:
@@ -234,7 +242,7 @@ class RallyEnv(gym.Env):
                 self._serve(toward_agent=False)
         elif self._bx >= g - 1:                 # agent's goal column
             if abs(self._by - self._agent_y) <= ahalf + 0.5:
-                self._bx, self._vx = float(g - 1), -1
+                self._bx, self._vx = self._scalar(g - 1), -1
                 self._vy = self._deflect(
                     (self._by - self._agent_y) / (ahalf + 0.5))
             else:

@@ -46,8 +46,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "replay",
-                            "infer", "status", "dqn", "aql", "r2d2",
-                            "apex", "enjoy"],
+                            "infer", "status", "loadgen", "dqn", "aql",
+                            "r2d2", "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator/replay "
                         "(one prioritized-replay shard — see "
                         "--replay-shards/--shard-id)/infer (the "
@@ -55,10 +55,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "--remote-policy actors); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
+                        "loadgen: standalone on-device rollout fleet "
+                        "saturating the chunk plane (training/anakin.py); "
                         "single-host drivers: dqn/aql/r2d2/apex; "
                         "enjoy: eval a checkpoint")
     p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
                    choices=["dqn", "aql", "r2d2"])
+    p.add_argument("--rollout", default=e.get("APEX_ROLLOUT", "host"),
+                   choices=["host", "ondevice"],
+                   help="learner/apex roles: 'ondevice' co-locates an "
+                        "Anakin rollout engine with the learner — env "
+                        "step + epsilon-greedy policy + chunk assembly "
+                        "fuse into one lax.scan on the training device, "
+                        "params never leave it (jittable envs only: "
+                        "ApexCatch*/ApexRally*; see envs/registry."
+                        "make_jax_env).  'host' (default) keeps the "
+                        "generic actor-process pipeline")
+    p.add_argument("--rollout-len", type=int,
+                   default=int(e.get("APEX_ROLLOUT_LEN", 0)),
+                   help="on-device scan length per dispatch (env steps "
+                        "per slot); 0 derives the chunk size "
+                        "(--send-interval twin) so each dispatch seals "
+                        "about one chunk per env slot")
     # env
     p.add_argument("--env-id", default=e.get("APEX_ENV_ID",
                                              "SeaquestNoFrameskip-v4"))
@@ -356,7 +374,18 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                     train_ratio=args.train_ratio,
                     min_train_ratio=args.min_train_ratio,
                     barrier_timeout_s=args.barrier_timeout,
-                    restore=args.restore)
+                    restore=args.restore, rollout=args.rollout,
+                    rollout_len=args.rollout_len or None)
+    elif args.role == "loadgen":
+        # standalone on-device rollout fleet (training/anakin.py): ships
+        # device-rate sealed chunks at the learner / replay shards — the
+        # synthetic heavy traffic the scale planes are measured against.
+        # Skips the startup barrier like replay/infer roles: it acts the
+        # moment the first param publish lands.
+        from apex_tpu.runtime.roles import run_loadgen
+        run_loadgen(cfg, identity, family=args.family,
+                    max_seconds=args.max_seconds,
+                    rollout_len=args.rollout_len or None)
     elif args.role == "actor":
         from apex_tpu.runtime.roles import run_actor
         run_actor(cfg, identity, family=args.family,
@@ -458,6 +487,21 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                     ApexTrainer as trainer_cls
             extra = dict(train_ratio=args.train_ratio,
                          min_train_ratio=args.min_train_ratio)
+            if args.rollout == "ondevice":
+                # co-located Anakin rollouts replace the actor processes;
+                # make_jax_env raises a ValueError naming non-jittable
+                # env ids, and the family gate fails loud before any
+                # trainer construction
+                if args.family != "dqn":
+                    raise NotImplementedError(
+                        f"--rollout ondevice currently serves the dqn "
+                        f"family only (got {args.family!r}) — aql/r2d2 "
+                        f"stay on the host pipeline (ROADMAP.md)")
+                from apex_tpu.training.anakin import (AnakinPool,
+                                                      make_anakin_engine)
+                engine = make_anakin_engine(
+                    cfg, rollout_len=args.rollout_len or None)
+                extra["pool"] = AnakinPool(cfg, engine)
             train_kw = dict(total_steps=args.total_steps,
                             max_seconds=args.max_seconds)
         t = trainer_cls(cfg, logdir=args.logdir, verbose=args.verbose,

@@ -147,14 +147,36 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
                 logdir: str | None = None, verbose: bool = False,
                 checkpoint_dir: str | None = None, train_ratio=None,
                 min_train_ratio=None, queue_depth: int = 64,
-                barrier_timeout_s: float = 120.0, restore: bool = False):
+                barrier_timeout_s: float = 120.0, restore: bool = False,
+                rollout: str = "host", rollout_len: int | None = None):
     """Learner role: barrier -> publish -> fused ingest+train loop.
 
     ``n_peers`` = actors + evaluators expected at the startup barrier
     (``learner.py:48-49``).  Returns the trainer (params, metrics history).
+
+    ``rollout="ondevice"`` co-locates an Anakin rollout engine with the
+    learner (:mod:`apex_tpu.training.anakin`): the socket pool keeps
+    serving any host actors/evaluators while sealed chunks ALSO stream
+    from the fused on-device scan — params hand to the engine as device
+    arrays, never leaving the accelerator.
     """
     pool = transport.RemotePool(cfg.comms, n_peers, queue_depth=queue_depth,
                                 barrier_timeout_s=barrier_timeout_s)
+    if rollout == "ondevice":
+        if family != "dqn":
+            pool.cleanup()
+            raise NotImplementedError(
+                f"--rollout ondevice currently serves the dqn family "
+                f"only (got {family!r}) — aql/r2d2 stay on the host "
+                f"pipeline (ROADMAP.md)")
+        from apex_tpu.training.anakin import AnakinPool, make_anakin_engine
+        try:
+            # make_jax_env raises a ValueError naming non-jittable env ids
+            engine = make_anakin_engine(cfg, rollout_len=rollout_len)
+        except BaseException:
+            pool.cleanup()
+            raise
+        pool = AnakinPool(cfg, engine, inner=pool)
     client = None
     if cfg.comms.replay_shards > 0:
         # sharded replay service: sampling lives in the shard fleet; the
@@ -316,6 +338,93 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
                   _ParamQueueAdapter(sub, park=park),
                   _StatQueueAdapter(sender),
                   stop_event, float(eps), chunk_arg)
+    finally:
+        sender.close()
+        sub.close()
+
+
+def run_loadgen(cfg: ApexConfig, identity: RoleIdentity,
+                family: str = "dqn", stop_event=None,
+                max_seconds: float = 86400.0,
+                rollout_len: int | None = None) -> dict:
+    """Loadgen role: the on-device Anakin rollout engine as a standalone
+    traffic source (:mod:`apex_tpu.training.anakin`).
+
+    Subscribes the param stream like an actor, then ships device-rate
+    sealed chunks down the normal chunk plane — hashed to the replay
+    shards when ``comms.replay_shards > 0``, learner-direct otherwise —
+    with heartbeats (role ``loadgen``) and episode stats riding the stat
+    channel, so the registry/status/chaos planes cover it for free.  The
+    credit window is the only throttle: this role exists to SATURATE the
+    ingest path for honest load measurement, where the CI box's host
+    actors top out two orders of magnitude lower.  Skips the startup
+    barrier (useful from the first publish, launch order free).  Returns
+    the counter dict for callers/tests."""
+    import time as time_lib
+
+    from apex_tpu.fleet.chaos import maybe_wrap_sender
+    from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+    from apex_tpu.obs import spans as obs_spans
+    from apex_tpu.obs.trace import set_process_label
+    from apex_tpu.training.anakin import make_anakin_engine
+
+    if family != "dqn":
+        raise NotImplementedError(
+            f"--role loadgen currently serves the dqn family only "
+            f"(got {family!r}) — see ROADMAP.md")
+    stop_event = stop_event or threading.Event()
+    name = f"loadgen-{identity.actor_id}"
+    set_process_label(name)
+    comms = _with_ips(cfg.comms, identity)
+    # engine first: make_jax_env's non-jittable ValueError must fire
+    # before any socket waits
+    engine = make_anakin_engine(
+        cfg, rollout_len=rollout_len,
+        n_envs=max(1, cfg.actor.n_envs_per_actor),
+        slot_band=identity.actor_id,
+        total_slots=max(identity.n_actors, 1)
+        * max(1, cfg.actor.n_envs_per_actor))
+
+    sub = transport.ParamSubscriber(comms)
+    sender = transport.ChunkSender(comms, name)
+    if comms.replay_shards > 0:
+        from apex_tpu.replay_service.sender import ShardedChunkSender
+        sender = ShardedChunkSender(comms, name, direct=sender)
+    sender = maybe_wrap_sender(sender, name)
+    beat = HeartbeatEmitter(
+        name, role="loadgen", interval_s=comms.heartbeat_interval_s,
+        counters_fn=(lambda: {
+            "chunks_sent": getattr(sender, "chunks_sent", 0),
+            "acks_received": getattr(sender, "acks_received", 0),
+            "resends": getattr(sender, "resends", 0),
+            "rerouted": getattr(sender, "rerouted", 0)}),
+        gauges_fn=(lambda: {
+            "ondevice_chunks": engine.chunks,
+            "ondevice_frames": engine.frames,
+            "ondevice_dispatches": engine.dispatches}))
+    try:
+        got = sub.wait_first(stop_event)
+        if got is None:
+            return {"chunks": 0, "frames": 0, "dispatches": 0}
+        version, params = got
+        t_end = time_lib.monotonic() + max_seconds
+        while not stop_event.is_set() and time_lib.monotonic() < t_end:
+            fresh = sub.poll(0)
+            if fresh is not None:
+                version, params = fresh
+            msgs, stats = engine.rollout(params)
+            beat.tick(engine.T * engine.B)
+            for stat in stats:
+                stat.param_version = version
+                sender.send_stat(stat)
+            hb = beat.maybe_beat(version)
+            if hb is not None:
+                sender.send_stat(hb)
+            for msg in msgs:
+                obs_spans.mark_send(msg, version)
+                sender.send_chunk(msg, stop_event)   # credit backpressure
+        return {"chunks": engine.chunks, "frames": engine.frames,
+                "dispatches": engine.dispatches}
     finally:
         sender.close()
         sub.close()

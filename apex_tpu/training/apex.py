@@ -161,6 +161,13 @@ class ConcurrentTrainer(CheckpointableTrainer):
             params = jax.tree.map(jnp.copy, self.train_state.params)
             self._pipeline.publish(self.param_version, params)
             return
+        if getattr(self.pool, "accepts_device_params", False):
+            # co-located on-device rollouts (training/anakin.py): hand the
+            # engine an on-device COPY (the next fused step donates
+            # train_state) — params never leave the device on this path
+            params = jax.tree.map(jnp.copy, self.train_state.params)
+            self.pool.publish_params(self.param_version, params)
+            return
         host_params = jax.device_get(self.train_state.params)
         self.pool.publish_params(self.param_version, host_params)
 
@@ -638,6 +645,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
         m["barrier_admitted"] = (admitted() if callable(admitted) else 0)
         withheld = getattr(self.pool, "acks_withheld", None)
         m["acks_withheld"] = (withheld() if callable(withheld) else 0)
+        ondevice = getattr(self.pool, "ondevice_counters", None)
+        if callable(ondevice):
+            # on-device rollout plane (training/anakin.py): dispatch/
+            # chunk/frame counters — the anakin-smoke CI drill asserts
+            # these are nonzero from the persisted summary
+            m["ondevice"] = ondevice()
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {

@@ -735,8 +735,14 @@ class IngestPipeline:
             return
         version, params = req
         t0 = time.perf_counter()
-        host_params = jax.device_get(params)
-        self.pool.publish_params(version, host_params)
+        if getattr(self.pool, "accepts_device_params", False):
+            # co-located on-device rollouts (training/anakin.py): the pool
+            # consumes the device copy directly — params never leave the
+            # device; the pool device_gets internally only when an inner
+            # socket fleet needs wire params (still on THIS thread)
+            self.pool.publish_params(version, params)
+        else:
+            self.pool.publish_params(version, jax.device_get(params))
         self.stats["publishes"] += 1
         self.ring.complete("publish", t0, time.perf_counter() - t0,
                            track="ingest-staging", args={"version": version})

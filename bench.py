@@ -1013,6 +1013,120 @@ def bench_infer_plane() -> dict:
             "pixel": ab(pixel, 16, max(10, steps // 4))}
 
 
+# -- part 1f: on-device Anakin rollout vs host vector-actor ------------------
+
+ONDEVICE_AB_TIMEOUT = float(os.environ.get("BENCH_ONDEVICE_TIMEOUT", 420.0))
+
+
+def bench_ondevice_rollout() -> dict:
+    """Part 1f: the fused on-device rollout engine (training/anakin.py —
+    env step + epsilon-greedy policy + chunk assembly in ONE lax.scan) vs
+    the host vector-actor loop on the same env/model/ladder.
+
+    The host lane is measured at TWO widths: ``host_default`` is the
+    shipping default topology (``n_envs_per_actor=1`` — the reference's
+    one-env-per-process shape), whose per-step dispatch + python overhead
+    is exactly what the fused scan retires (the 5x-class win on this
+    1-core box); ``host_wide`` is width-matched to the engine's B, where
+    both lanes are policy-conv-bound on one core and the multiplier
+    collapses toward parity — the honest ceiling ``effective_cores``
+    contextualizes, and the lane a TPU run blows open (the conv is ~free
+    on the MXU while the host lane stays CPU-bound).  ``chunks_per_sec``/
+    ``transitions_per_sec`` are the sealed-chunk rate into the replay
+    path — the loadgen saturation figure."""
+    import jax
+    import numpy as np
+
+    from apex_tpu.actors.pool import actor_epsilons
+    from apex_tpu.actors.vector import VectorDQNWorkerFamily
+    from apex_tpu.config import ActorConfig, ApexConfig, EnvConfig
+    from apex_tpu.models.dueling import DuelingDQN
+    from apex_tpu.ops.losses import make_optimizer
+    from apex_tpu.training.anakin import make_anakin_engine
+    from apex_tpu.training.apex import dqn_env_specs
+    from apex_tpu.training.state import create_train_state
+
+    dispatches = int(os.environ.get("BENCH_ONDEVICE_STEPS", 12))
+    rollout_len = int(os.environ.get("BENCH_ONDEVICE_T", 64))
+
+    def ab(env_cfg: EnvConfig, n_envs: int) -> dict:
+        cfg = ApexConfig(env=env_cfg,
+                         actor=ActorConfig(n_actors=1,
+                                           n_envs_per_actor=n_envs,
+                                           send_interval=64))
+        model_spec, frame_shape, frame_dtype, frame_stack = \
+            dqn_env_specs(cfg)
+        model = DuelingDQN(**model_spec)
+        stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+        ts = create_train_state(model, make_optimizer(),
+                                jax.random.key(0),
+                                np.zeros((1,) + stacked, frame_dtype))
+        params = jax.device_get(ts.params)
+
+        engine = make_anakin_engine(cfg, rollout_len=rollout_len)
+        engine.rollout(params)                       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            engine.rollout(params)
+        dt = time.perf_counter() - t0
+        out = {"n_envs": n_envs, "rollout_len": engine.T,
+               "dispatches": dispatches,
+               "ondevice": {
+                   "frames_per_sec":
+                       round(dispatches * engine.T * engine.B / dt, 1),
+                   "chunks_per_sec": round(engine.chunks / dt, 2),
+                   "transitions_per_sec":
+                       round(engine.transitions / dt, 1),
+                   "seconds": round(dt, 2)}}
+
+        for label, hb, steps in (("host_default", 1, 300),
+                                 ("host_wide", n_envs, 40)):
+            hcfg = ApexConfig(env=env_cfg,
+                              actor=ActorConfig(n_actors=1,
+                                                n_envs_per_actor=hb,
+                                                send_interval=64))
+            fam = VectorDQNWorkerFamily(
+                hcfg, model_spec,
+                seeds=[hcfg.env.seed + 1000 * (s + 1) for s in range(hb)],
+                slot_ids=list(range(hb)),
+                epsilons=actor_epsilons(max(hb, 1)), chunk_transitions=64)
+            fam.reset_all()
+            key = jax.random.key(7)
+            for _ in range(5):
+                key, k = jax.random.split(key)
+                fam.step_all(params, k)
+                fam.poll_msgs()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                key, k = jax.random.split(key)
+                fam.step_all(params, k)
+                fam.poll_msgs()
+            hdt = time.perf_counter() - t0
+            out[label] = {"n_envs": hb,
+                          "frames_per_sec": round(steps * hb / hdt, 1),
+                          "seconds": round(hdt, 2)}
+            fam.close()
+
+        ond = out["ondevice"]["frames_per_sec"]
+        out["speedup"] = (round(ond
+                                / out["host_default"]["frames_per_sec"],
+                                2)
+                          if out["host_default"]["frames_per_sec"]
+                          else None)
+        out["speedup_vs_wide"] = (
+            round(ond / out["host_wide"]["frames_per_sec"], 2)
+            if out["host_wide"]["frames_per_sec"] else None)
+        return out
+
+    toy = EnvConfig(env_id="ApexCatchSmall-v0", frame_stack=2,
+                    clip_rewards=False, episodic_life=False)
+    pixel = EnvConfig(env_id="ApexCatch-v0", frame_stack=FRAME_STACK,
+                      clip_rewards=False, episodic_life=False)
+    return {"effective_cores": _effective_cores(),
+            "toy": ab(toy, 32),
+            "pixel": ab(pixel, 16)}
+
+
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
 def _fleet_section(trainer) -> dict | None:
@@ -1232,6 +1346,18 @@ def main() -> None:
             iab = {"error": f"{type(exc).__name__}: {exc}"[:400]}
         with _print_lock:
             RESULT["infer_plane_ab"] = iab
+
+    if os.environ.get("BENCH_SKIP_ONDEVICE", "0") != "1":
+        # part 1f: the fused on-device rollout engine vs the host
+        # vector-actor path (frames/s at the default and width-matched
+        # host topologies + sealed chunk/s into replay + effective_cores)
+        _arm("ondevice_rollout_ab", ONDEVICE_AB_TIMEOUT)
+        try:
+            oab = bench_ondevice_rollout()
+        except Exception as exc:   # the headline metric survives regardless
+            oab = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["ondevice_rollout_ab"] = oab
 
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU

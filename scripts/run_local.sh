@@ -45,6 +45,15 @@ mkdir -p "$TRACE_DIR"
 REPLAY_SHARDS="${APEX_REPLAY_SHARDS:-0}"
 export APEX_REPLAY_SHARDS="$REPLAY_SHARDS"
 
+# On-device Anakin rollouts (apex_tpu/training/anakin): export
+# APEX_ROLLOUT=ondevice and the learner co-locates a fused
+# env+policy+chunk-assembly scan with the fused trainer — params never
+# leave the device, sealed chunks enter the normal replay path, and the
+# topology can run with ZERO host actors (N_ACTORS=0; the evaluator
+# still rides the param stream).  Jittable envs only
+# (ApexCatch*/ApexRally* — the CLI fails loud otherwise).
+export APEX_ROLLOUT="${APEX_ROLLOUT:-host}"
+
 # Centralized inference plane (apex_tpu/infer_service): export
 # APEX_REMOTE_POLICY=1 to launch a `--role infer` policy server and make
 # the actors ship half-group observations to it (one batched device
@@ -98,7 +107,7 @@ if [ "$REMOTE_POLICY" = "1" ]; then
   pids+=($!)
 fi
 
-for i in $(seq 0 $((N_ACTORS - 1))); do
+for i in $(seq 0 $((N_ACTORS - 1))); do   # N_ACTORS=0: no host actors
   python -m apex_tpu.runtime --role actor --actor-id "$i" \
     "${COMMON[@]}" &
   pids+=($!)

@@ -1149,6 +1149,93 @@ def test_j013_silent_on_two_threads_two_sockets():
         """, "J013")
 
 
+# -- J014: host numpy op in a lax.scan-scanned env/rollout body --------------
+
+def test_j014_fires_on_np_in_scan_body():
+    assert fires("""
+        import jax
+        import numpy as np
+        def rollout(state, keys):
+            def body(carry, key):
+                pos = np.clip(carry + 1, 0, 10)
+                return pos, pos
+            return jax.lax.scan(body, state, keys)
+        """, "J014")
+
+
+def test_j014_fires_through_lambda_and_method_closure():
+    # the anakin shape: lax.scan(lambda c, x: self._step(...)) — the
+    # method and its callees are scanned scope via the call graph
+    assert fires("""
+        import jax
+        import numpy as np
+        class Engine:
+            def _flush(self, c):
+                return np.concatenate([c, c])
+            def _step(self, c, x):
+                return self._flush(c), x
+            def _dispatch(self, c, xs):
+                return jax.lax.scan(lambda cc, x: self._step(cc, x),
+                                    c, xs)
+        """, "J014")
+
+
+def test_j014_fires_on_float_and_item():
+    assert fires("""
+        import jax
+        def rollout(state, keys):
+            def body(carry, key):
+                r = float(carry)
+                return carry, r
+            return jax.lax.scan(body, state, keys)
+        """, "J014")
+    assert fires("""
+        import jax
+        def rollout(state, keys):
+            def body(carry, key):
+                return carry, carry.item()
+            return jax.lax.scan(body, state, keys)
+        """, "J014")
+
+
+def test_j014_silent_outside_scan_and_on_static_args():
+    # np on the host side of the dispatch is the NORMAL pattern
+    assert not fires("""
+        import jax
+        import numpy as np
+        def host_convert(out):
+            return np.asarray(out)
+        def rollout(state, keys):
+            def body(carry, key):
+                return carry + 1, carry
+            return jax.lax.scan(body, state, keys)
+        """, "J014")
+    # static shape/config construction at trace time is legitimate
+    assert not fires("""
+        import jax
+        import numpy as np
+        class Engine:
+            def _step(self, c, x):
+                d = np.prod(self.frame_shape)
+                ar = np.arange(self.B)
+                return c, d
+            def _dispatch(self, c, xs):
+                return jax.lax.scan(lambda cc, x: self._step(cc, x),
+                                    c, xs)
+        """, "J014")
+
+
+def test_j014_silent_on_jnp_in_scan_body():
+    assert not fires("""
+        import jax
+        import jax.numpy as jnp
+        def rollout(state, keys):
+            def body(carry, key):
+                return jnp.clip(carry + 1, 0, 10), carry
+            return jax.lax.scan(body, state, keys)
+        """, "J014")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():
