@@ -670,3 +670,164 @@ class ZmqThreadAffinity(Rule):
                     f"the others a queue (ChunkReceiver routes decoder "
                     f"acks through _ack_q for exactly this reason)"))
         return out
+
+
+# -- J015 -------------------------------------------------------------------
+
+
+@register
+class UnregisteredGauge(Rule):
+    id = "J015"
+    name = "unregistered-gauge"
+    description = ("a literal heartbeat-gauge key or Prometheus "
+                   "exposition family name outside the declared metric "
+                   "registry (apex_tpu.obs.metrics REGISTERED_GAUGES / "
+                   "REGISTERED_FAMILIES): an undeclared metric is "
+                   "silently unscrapeable — the status table shows it, "
+                   "but the SLO engine, dashboards, and alert rules can "
+                   "never address it by name.  Register the key next to "
+                   "its emitter")
+
+    #: exposition dict kwargs with FIXED family-name keys (``gauges=``
+    #: stays exempt: production gauge names there are dynamic scalar
+    #: tails, not a closed registry)
+    _RENDER_KWARGS = ("counters", "histograms", "labeled")
+
+    @staticmethod
+    def _registries() -> tuple[frozenset, frozenset] | None:
+        """The declared registry, imported from the real module (pure
+        stdlib — obs.metrics imports only ``re``); None disables the
+        rule rather than inventing an empty registry that would flag
+        every gauge in sight."""
+        try:
+            from apex_tpu.obs.metrics import (REGISTERED_FAMILIES,
+                                              REGISTERED_GAUGES)
+            return REGISTERED_GAUGES, REGISTERED_FAMILIES
+        except Exception:
+            return None
+
+    @staticmethod
+    def _dict_assigns(fn: ast.AST) -> dict[str, list[ast.Dict]]:
+        """name -> dict-literal assignments inside one function (the
+        one-hop local dataflow the rule follows)."""
+        out: dict[str, list[ast.Dict]] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, []).append(n.value)
+        return out
+
+    def _resolve_dicts(self, value: ast.AST,
+                       local: dict[str, list[ast.Dict]]) -> list[ast.Dict]:
+        """Dict literals a sink argument resolves to: the literal
+        itself, a local name assigned one, or a lambda returning one."""
+        if isinstance(value, ast.Dict):
+            return [value]
+        if isinstance(value, ast.Name):
+            return local.get(value.id, [])
+        if isinstance(value, ast.Lambda) and isinstance(value.body,
+                                                        ast.Dict):
+            return [value.body]
+        return []
+
+    @staticmethod
+    def _returned_dicts(fn: ast.AST) -> list[ast.Dict]:
+        """Dict literals a function returns (directly or via one local
+        assignment)."""
+        local = UnregisteredGauge._dict_assigns(fn)
+        out: list[ast.Dict] = []
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Return) or n.value is None:
+                continue
+            if isinstance(n.value, ast.Dict):
+                out.append(n.value)
+            elif isinstance(n.value, ast.Name):
+                out.extend(local.get(n.value.id, []))
+        return out
+
+    def _check_keys(self, ctx: ModuleContext, d: ast.Dict,
+                    registry: frozenset, what: str,
+                    out: list[Finding]) -> None:
+        for key in d.keys:
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)):
+                continue            # dynamic keys: not literal dataflow
+            if key.value not in registry:
+                out.append(ctx.finding(
+                    self, key,
+                    f"{what} key '{key.value}' is not in the declared "
+                    f"metric registry (apex_tpu.obs.metrics) — register "
+                    f"it there or the SLO/scrape planes can never "
+                    f"address it"))
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        regs = self._registries()
+        if regs is None:
+            return []
+        gauges_reg, families_reg = regs
+        out: list[Finding] = []
+        by_name: dict[str, list] = {}
+        for fn in ctx.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        # 1) functions literally named `gauges` (the infer server/client
+        #    convention) — their returned dict literals ARE gauge sets
+        for fn in by_name.get("gauges", []):
+            for d in self._returned_dicts(fn):
+                self._check_keys(ctx, d, gauges_reg, "heartbeat gauge",
+                                 out)
+        seen_fn_targets: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn_scope = ctx.enclosing_function(node)
+            local = (self._dict_assigns(fn_scope)
+                     if fn_scope is not None else {})
+            # 2) Heartbeat(gauges={...}) and gauges_fn=... sinks
+            gv = _kwarg(node, "gauges")
+            if gv is not None and _callee_basename(node) == "Heartbeat":
+                for d in self._resolve_dicts(gv, local):
+                    self._check_keys(ctx, d, gauges_reg,
+                                     "heartbeat gauge", out)
+            gf = _kwarg(node, "gauges_fn")
+            if gf is not None:
+                for d in self._resolve_dicts(gf, local):
+                    self._check_keys(ctx, d, gauges_reg,
+                                     "heartbeat gauge", out)
+                # a named/bound hook (`gauges_fn=self.ondevice_counters`)
+                # resolves to the module function of that name
+                name = (gf.id if isinstance(gf, ast.Name)
+                        else gf.attr if isinstance(gf, ast.Attribute)
+                        else None)
+                if name and name not in seen_fn_targets:
+                    seen_fn_targets.add(name)
+                    for fn in by_name.get(name, []):
+                        for d in self._returned_dicts(fn):
+                            self._check_keys(ctx, d, gauges_reg,
+                                             "heartbeat gauge", out)
+            # 3) exposition family names: dict literals handed to
+            #    render(counters=/histograms=/labeled=)
+            if _callee_basename(node) == "render":
+                for kw in self._RENDER_KWARGS:
+                    v = _kwarg(node, kw)
+                    if v is None:
+                        continue
+                    for d in self._resolve_dicts(v, local):
+                        self._check_keys(ctx, d, families_reg,
+                                         "exposition family", out)
+        # 4) exposition builders: render_*/prometheus_* functions that
+        #    ASSEMBLE the (gauges, labeled) sections other modules hand
+        #    to render() — their literal dicts bound to the section
+        #    names are family declarations too
+        for fn in ctx.functions:
+            if not fn.name.startswith(("render_", "prometheus")):
+                continue
+            local = self._dict_assigns(fn)
+            # builder scope includes `gauges`: here the names ARE fixed
+            # families (slo_severity...), unlike render()'s dynamic
+            # scalar-tail gauges
+            for kw in self._RENDER_KWARGS + ("gauges",):
+                for d in local.get(kw, []):
+                    self._check_keys(ctx, d, families_reg,
+                                     "exposition family", out)
+        return out

@@ -231,7 +231,9 @@ class TracedPythonBranch(Rule):
 # -- J004 -------------------------------------------------------------------
 
 
-_KEY_SOURCE_ATTRS = {"split", "PRNGKey", "fold_in"}
+#: split is NOT here: it needs a random-ish receiver (_is_key_source) or
+#: str.split unpacks would mint phantom keys
+_KEY_SOURCE_ATTRS = {"PRNGKey", "fold_in"}
 # params opt into tracking by JAX's `key` convention only — `rng` is the
 # numpy.random.Generator convention, where reuse is the whole point
 _KEY_NAME_RE = re.compile(r"key", re.IGNORECASE)
@@ -242,6 +244,15 @@ def _is_key_source(call: ast.Call) -> bool:
     f = call.func
     if not isinstance(f, ast.Attribute):
         return False
+    if f.attr == "split":
+        # require a random-ish receiver, like `.key` below: plain
+        # ``path.split(":")`` is str.split — its unpack targets are not
+        # PRNG keys (the engine used to flag any later loop use of them)
+        recv = f.value
+        recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else "")
+        return ("random" in recv_name
+                or recv_name in ("jr", "jrandom", "rng"))
     if f.attr in _KEY_SOURCE_ATTRS:
         return True
     if f.attr == "key":

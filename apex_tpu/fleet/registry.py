@@ -287,6 +287,13 @@ def format_fleet_table(snapshot: dict) -> str:
         if g:
             lines.append(f"{p['identity']}: " + " ".join(
                 f"{k}={g[k]}" for k in sorted(g)))
+    # fleet SLO objectives (apex_tpu/obs/slo): one line per judged/
+    # observed objective when the learner runs the engine — the operator
+    # table answers "is the fleet in objective" without a scrape stack
+    slo = snapshot.get("slo")
+    if slo:
+        from apex_tpu.obs.slo import format_slo_lines
+        lines.extend(format_slo_lines(slo))
     return "\n".join(lines)
 
 

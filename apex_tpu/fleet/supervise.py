@@ -25,18 +25,31 @@ supervisor into a fleet-sized one — it keeps between ``--scale-min`` and
 ``--scale-max`` copies of the role command alive (the ``{slot}``
 placeholder in the command becomes each child's slot index, i.e. its
 actor id), and every ``--scale-interval`` seconds probes the learner's
-status port for the aggregate actor drain-bound fraction (PR 4's
-``ActorTimingStat`` signal, surfaced in the trainer's fleet summary).  A
-drain-BOUND fleet is backpressured by the learner — more actors buy
-nothing, scale down; a fleet that barely drains means the learner is
-starving for data — scale up.  One step per tick, clamped.
+status port for a scaling signal.  Two signals (``--scale-signal``):
+
+* ``drain`` (default, PR 8): the aggregate actor drain-bound fraction
+  (PR 4's ``ActorTimingStat``, surfaced in the trainer's fleet
+  summary).  A drain-BOUND fleet is backpressured by the learner — more
+  actors buy nothing, scale down; a fleet that barely drains means the
+  learner is starving for data — scale up.
+* ``slo``: the fleet SLO engine's alert snapshot
+  (:mod:`apex_tpu.obs.slo`, the ROADMAP serving-tier item verbatim): a
+  page-grade BREACH means the tier is out of objective — add capacity;
+  a fleet whose every judged objective has burned ZERO error budget
+  over the slow window ("idle") can retire a replica; everything
+  between (BURNING, warn, RESOLVED cooldown) holds.  The round-trip
+  p99 objective makes this exactly "autoscale the infer tier on its
+  latency SLO".
+
+One step per tick, clamped, either signal.
 
 Usage::
 
     python -m apex_tpu.fleet.supervise [--max-respawns N] [--window S]
         [--min-uptime S] [--backoff S] [--backoff-max S] -- CMD [ARG...]
     python -m apex_tpu.fleet.supervise --scale-min 1 --scale-max 8 \
-        [--scale-interval S] [--learner-ip IP] [--status-port P] \
+        [--scale-signal drain|slo] [--scale-interval S] \
+        [--learner-ip IP] [--status-port P] \
         -- CMD --actor-id {slot} [ARG...]
 """
 
@@ -74,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic mode floor (default 1)")
     p.add_argument("--scale-interval", type=float, default=30.0,
                    help="seconds between backpressure probes (default 30)")
+    p.add_argument("--scale-signal", choices=["drain", "slo"],
+                   default="drain",
+                   help="elastic mode sizing signal: 'drain' = actor "
+                        "drain-bound fraction (PR 8 backpressure), "
+                        "'slo' = the fleet SLO engine's alert severity "
+                        "(apex_tpu/obs/slo — breach adds capacity, a "
+                        "zero-burn fleet retires one)")
     p.add_argument("--learner-ip", default="127.0.0.1",
                    help="elastic mode: learner host for the status probe")
     p.add_argument("--status-port", type=int, default=52003,
@@ -105,6 +125,50 @@ def scale_decision(drain_frac: float | None, n_now: int, n_min: int,
     else:
         target = n_now
     return max(n_min, min(n_max, target))
+
+
+def scale_decision_slo(slo: dict | None, n_now: int, n_min: int,
+                       n_max: int) -> int:
+    """Target child count from the SLO engine's snapshot (the
+    ``--scale-signal slo`` decision, fed by :func:`fleet_slo`).
+
+    A page-grade breach (``severity >= 2``) means the tier is failing
+    its objective — one more replica; an ``idle`` fleet (every judged
+    objective at ZERO budget burn over the slow window) is provably
+    over-provisioned — one fewer.  BURNING/warn/RESOLVED-cooldown and an
+    unreadable snapshot (None — learner unreachable, engine not up yet)
+    hold: scaling on a half-clear signal is how autoscalers flap.  One
+    step per tick, clamped, like :func:`scale_decision`."""
+    if not slo:
+        target = n_now
+    elif int(slo.get("severity", 0)) >= 2:
+        target = n_now + 1
+    elif slo.get("idle"):
+        target = n_now - 1
+    else:
+        target = n_now
+    return max(n_min, min(n_max, target))
+
+
+def fleet_slo(learner_ip: str = "127.0.0.1", status_port: int = 52003,
+              timeout_s: float = 5.0) -> dict | None:
+    """One status round-trip for the trainer's SLO snapshot (the ``slo``
+    section of the fleet summary), or None when nothing answers / no
+    engine is running.  Lazy zmq, like :func:`fleet_drain_frac`."""
+    import dataclasses
+
+    from apex_tpu.config import CommsConfig
+    from apex_tpu.fleet.registry import status_request
+
+    comms = dataclasses.replace(CommsConfig(), status_port=status_port)
+    try:
+        snap = status_request(comms, learner_ip=learner_ip,
+                              timeout_s=timeout_s)
+    except Exception:
+        return None
+    if not snap:
+        return None
+    return snap.get("slo")
 
 
 def fleet_drain_frac(learner_ip: str = "127.0.0.1",
@@ -140,14 +204,17 @@ class ScaleSupervisor:
     exported per life, so chaos kills stay first-life-only); scale-down
     retires the HIGHEST slots first (the greediest end of the ladder).
 
-    ``spawn(cmd, env) -> handle`` and ``probe() -> float | None`` inject
-    for tests; a handle needs ``poll()`` and ``terminate()``.
+    ``spawn(cmd, env) -> handle`` and ``probe() -> signal`` inject for
+    tests; a handle needs ``poll()`` and ``terminate()``.  ``decide``
+    maps ``(signal, n_now, n_min, n_max) -> target`` — default is the
+    drain-frac :func:`scale_decision`; ``--scale-signal slo`` swaps in
+    :func:`scale_decision_slo` with :func:`fleet_slo` as the probe.
     """
 
     def __init__(self, cmd: list[str], n_min: int, n_max: int,
                  interval_s: float = 30.0, probe=None, spawn=None,
                  clock=time.monotonic, sleep=time.sleep,
-                 high: float = 0.5, low: float = 0.15):
+                 high: float = 0.5, low: float = 0.15, decide=None):
         import os
 
         self.cmd = list(cmd)
@@ -160,6 +227,10 @@ class ScaleSupervisor:
         self._clock = clock
         self._sleep = sleep
         self.high, self.low = float(high), float(low)
+        self.decide = decide or (
+            lambda sig, n, lo, hi: scale_decision(sig, n, lo, hi,
+                                                  high=self.high,
+                                                  low=self.low))
         self.children: dict[int, object] = {}       # slot -> handle
         self._lives: dict[int, int] = {}            # slot -> spawn count
         self.target = self.n_min
@@ -191,16 +262,16 @@ class ScaleSupervisor:
                 del self.children[slot]
                 if slot < self.target:
                     self._spawn(slot)
-        new = scale_decision(self.probe(), self.target, self.n_min,
-                             self.n_max, high=self.high, low=self.low)
+        new = self.decide(self.probe(), self.target, self.n_min,
+                          self.n_max)
         if new > self.target:
             self.scale_ups += 1
-            print(f"supervise: scale up {self.target} -> {new} "
-                  f"(learner starving)", flush=True)
+            print(f"supervise: scale up {self.target} -> {new}",
+                  flush=True)
         elif new < self.target:
             self.scale_downs += 1
-            print(f"supervise: scale down {self.target} -> {new} "
-                  f"(fleet drain-bound)", flush=True)
+            print(f"supervise: scale down {self.target} -> {new}",
+                  flush=True)
         self.target = new
         self._apply_target()
 
@@ -324,11 +395,17 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
     if args.scale_max > 0:
+        if args.scale_signal == "slo":
+            probe = (lambda: fleet_slo(args.learner_ip,
+                                       args.status_port))
+            decide = scale_decision_slo
+        else:
+            probe = (lambda: fleet_drain_frac(args.learner_ip,
+                                              args.status_port))
+            decide = None           # the drain-frac default
         sup = ScaleSupervisor(
             cmd, n_min=args.scale_min, n_max=args.scale_max,
-            interval_s=args.scale_interval,
-            probe=lambda: fleet_drain_frac(args.learner_ip,
-                                           args.status_port))
+            interval_s=args.scale_interval, probe=probe, decide=decide)
         return sup.run()
     return supervise(cmd, max_respawns=args.max_respawns,
                      window_s=args.window, min_uptime_s=args.min_uptime,

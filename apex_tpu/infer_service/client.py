@@ -94,7 +94,15 @@ class InferClient:
         self.stale_epoch = 0
         self.rejected = 0
         self.reprobes = 0
-        self.round_trip = LatencyHistogram()
+        # Round-trip observations, INCLUDING censored ones: a request
+        # that times out into the fallback records its elapsed wait (>=
+        # wait_s) — the SRE discipline that timeouts count against the
+        # latency SLO at the timeout value, or p99 goes blind exactly
+        # when the server dies.  The window is deliberately smaller than
+        # the default 4096 so the p99 gauge recovers within seconds of a
+        # respawned server taking traffic back instead of dragging dead-
+        # server samples around for the rest of the run.
+        self.round_trip = LatencyHistogram(window=1024)
         self.epoch_seen = 0             # newest learner epoch in a reply
         self.last_version = 0           # newest param version in a reply
         from apex_tpu.obs.trace import get_ring
@@ -168,6 +176,11 @@ class InferClient:
                 remaining = deadline - self._clock()
                 if remaining <= 0:
                     self._outstanding.discard(rid)
+                    # censored round-trip: the timeout IS the observed
+                    # latency (see round_trip above) — the SLO engine's
+                    # infer_rt_p99_ms objective breaches on a dead
+                    # server through exactly these samples
+                    self.round_trip.record(self._clock() - pending.t0)
                     if self._down_since is None:
                         self._down_since = self._clock()
                     break
@@ -227,7 +240,8 @@ class InferClient:
                 "infer_stale_epoch": self.stale_epoch,
                 "infer_reprobes": self.reprobes,
                 "infer_rt_ms_p50": round(rt["p50_s"] * 1000.0, 3),
-                "infer_rt_ms_p90": round(rt["p90_s"] * 1000.0, 3)}
+                "infer_rt_ms_p90": round(rt["p90_s"] * 1000.0, 3),
+                "infer_rt_ms_p99": round(rt["p99_s"] * 1000.0, 3)}
 
     def close(self) -> None:
         self.sock.close(linger=0)

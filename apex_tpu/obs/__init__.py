@@ -17,7 +17,22 @@ Three surfaces, one unit of account — a frame chunk:
 * :mod:`apex_tpu.obs.metrics` — Prometheus text exposition served from
   the existing fleet-status REP server (port 52003), so MetricLogger
   tails, rates, fleet states, and the latency histograms are pollable
-  by standard tooling.
+  by standard tooling — plus the declared metric registry
+  (``REGISTERED_GAUGES``/``REGISTERED_FAMILIES``) apexlint J015
+  enforces on every literal gauge/family name.
+
+Two judging layers sit on top of those signals:
+
+* :mod:`apex_tpu.obs.slo` — the fleet SLO engine: declarative
+  objectives over the fleet-summary signal space, multi-window
+  burn-rate evaluation on the learner's health tick, flap-damped
+  OK -> BURNING -> BREACHED -> RESOLVED alert machines, ``apex_slo_*``
+  exposition rows, the ``--scale-signal slo`` autoscaling input, and
+  the ``--check`` bench/soak regression differ.
+* :mod:`apex_tpu.obs.soak` — the standing saturation soak: a
+  loadgen-saturated fleet driven for a wall budget with the engine
+  sampled each tick, emitting the machine-readable ``SOAK_*.json``
+  artifact (compliance %, alert timeline, throughput vs offered load).
 
 Everything here is stdlib-only and hot-loop-safe: clock reads and deque
 appends, no device syncs (apexlint J006) — and apexlint J010 flags any

@@ -17,6 +17,48 @@ from __future__ import annotations
 
 import re
 
+#: Declared heartbeat-gauge keys.  Every literal key a role puts into
+#: ``Heartbeat.gauges`` (directly, via a ``gauges_fn`` hook, or from a
+#: method named ``gauges``) must come from this set — apexlint J015
+#: (``unregistered-gauge``) enforces it, so a typo'd or undeclared gauge
+#: is a lint failure instead of a silently unscrapeable metric the SLO
+#: engine can never objective on.  Grow this set WITH the emitter.
+REGISTERED_GAUGES = frozenset({
+    # infer server serving gauges (infer_service/service.py)
+    "queue_depth", "batch_p50", "batch_p90", "coalesce_ms_p50",
+    "requests", "replies", "dry_replies", "rejected",
+    # remote-policy actor health (infer_service/client.py)
+    "infer_remote", "infer_fallbacks", "infer_stale_epoch",
+    "infer_reprobes", "infer_rt_ms_p50", "infer_rt_ms_p90",
+    "infer_rt_ms_p99",
+    # on-device rollout planes (training/anakin.py, --role loadgen)
+    "ondevice_chunks", "ondevice_frames", "ondevice_dispatches",
+    "dispatches", "chunks", "frames", "transitions", "rollout_len",
+    "n_envs",
+    # evaluator eval-ladder scores (runtime/roles.py — the SLO engine's
+    # model-quality signal and the future canary/promotion gate input)
+    "eval_band", "eval_episodes", "eval_score_last", "eval_score_mean",
+})
+
+#: Declared Prometheus exposition families: the fixed row names the
+#: scrape surface serves (literal keys of the ``counters``/
+#: ``histograms``/``labeled`` dicts handed to :func:`render`).  J015's
+#: other half — dynamic names (scalar tails, per-peer gauges) ride the
+#: registered ``fleet_peer_gauge``/``slo_*`` families instead of
+#: minting rows ad hoc.
+REGISTERED_FAMILIES = frozenset({
+    # fleet registry exposition (render_fleet)
+    "fleet_peer_up", "fleet_peer_fps", "fleet_peer_chunks_sent",
+    "fleet_peer_gauge",
+    # learner exposition (training/apex.py _metrics_text)
+    "learner_steps_total", "transitions_ingested_total", "param_version",
+    "stat_drops_total", "frame_age_at_train_seconds",
+    "param_propagation_lag_seconds",
+    # SLO engine rows (obs/slo.py prometheus_sections)
+    "slo_severity", "slo_ticks", "slo_state", "slo_value",
+    "slo_burn_fast", "slo_breaches", "slo_compliance_pct",
+})
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 

@@ -537,6 +537,26 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
     version, params = got
     if park is not None:
         park.note_params()
+    # eval-ladder scores ride the heartbeat gauges: each evaluator IS
+    # one band (its actor_id slot — N evaluators span the eval ladder
+    # the way actor ids span the epsilon ladder), and its recent-window
+    # mean + episode count reach the registry/status/Prometheus surface
+    # on the beats it already sends — so the SLO engine (and the future
+    # canary/promotion gate) can objective on MODEL QUALITY
+    # (obs/slo.py `eval_score`), not just plumbing.
+    from collections import deque as _deque
+    recent_scores: _deque = _deque(maxlen=16)
+    scores: list[float] = []
+
+    def _eval_gauges() -> dict:
+        return {
+            "eval_band": identity.actor_id,
+            "eval_episodes": len(scores),
+            "eval_score_last": (round(scores[-1], 3) if scores else 0.0),
+            "eval_score_mean": (round(sum(recent_scores)
+                                      / len(recent_scores), 3)
+                                if recent_scores else 0.0)}
+
     emitter = HeartbeatEmitter(
         park.identity if park is not None
         else f"evaluator-{identity.actor_id}",
@@ -544,9 +564,9 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
         counters_fn=(lambda: {
             "chunks_sent": getattr(sender, "chunks_sent", 0),
             "acks_received": getattr(sender, "acks_received", 0)}),
-        park_fn=park.park_state if park is not None else None)
+        park_fn=park.park_state if park is not None else None,
+        gauges_fn=_eval_gauges)
     key = jax.random.key(cfg.env.seed + 31337)
-    scores: list[float] = []
     ep = 0
     while not stop_event.is_set() and (episodes <= 0 or ep < episodes):
         obs, _ = env.reset()
@@ -565,6 +585,7 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             if hb is not None:
                 sender.send_stat(hb)
         scores.append(total)
+        recent_scores.append(total)
         ring.complete("episode", ep_t0, time.perf_counter() - ep_t0,
                       track="eval-episodes",
                       args={"reward": round(total, 3), "steps": steps,

@@ -144,6 +144,13 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # full fleet) and restores as peers rejoin
     _floor_relaxed = False
     floor_relaxes = 0        # times the floor reaction engaged
+    # fleet SLO engine (apex_tpu/obs/slo): declarative objectives judged
+    # by multi-window burn rates on every health tick; alert states land
+    # in fleet_summary.json / the status table / apex_slo_* Prometheus
+    # rows, and the scale supervisor's --scale-signal slo keys off the
+    # snapshot's severity.  Lazily built on the first health tick so
+    # knob env twins set by a drill are honored.
+    _slo = None
 
     # -- param plane -------------------------------------------------------
 
@@ -480,6 +487,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                              "fleet_dead": fm["dead"],
                              "fleet_parked": fm["parked"],
                              "fleet_rejoins": fm["rejoins"]}, steps)
+                    # judge BEFORE reacting: the floor reaction consults
+                    # the actor-capacity alert the sample just advanced
+                    self._slo_tick(steps)
                     self._react_to_fleet(steps)
                     self._dump_fleet_summary()
                     last_health = now
@@ -616,8 +626,55 @@ class ConcurrentTrainer(CheckpointableTrainer):
         if self._dispatch_gap is not None:
             snap = self._dispatch_gap.snapshot()
             gauges.update({f"learner_{k}": v for k, v in snap.items()})
+        if self._slo is not None:
+            # apex_slo_* rows: objective states/burns/compliance, so a
+            # stock alertmanager can page off the same machine the
+            # autoscaler scales from
+            from apex_tpu.obs import slo as obs_slo
+            slo_gauges, slo_labeled = obs_slo.prometheus_sections(
+                self._slo.snapshot())
+            gauges.update(slo_gauges)
+            labeled.update(slo_labeled)
         return obs_metrics.render(gauges=gauges, counters=counters,
                                   histograms=histograms, labeled=labeled)
+
+    # -- fleet SLO engine (apex_tpu/obs/slo) -------------------------------
+
+    def _slo_signals(self) -> dict:
+        """The signal space one engine sample judges: registry peers +
+        metrics, the obs-plane latency histograms, and the learner's
+        rate counters — the same sections ``fleet_summary`` publishes,
+        so an objective's signal path reads identically off the live
+        engine and the persisted JSON."""
+        snap = self.fleet.snapshot()
+        m = snap["metrics"]
+        m["dead_actor_frac"] = round(
+            self.fleet.dead_fraction(roles=("actor",)), 4)
+        return {
+            "peers": snap["peers"], "metrics": m,
+            "latency": (self._obs.summary()
+                        if self._obs is not None else {}),
+            "rates": {"steps_per_s": self.steps_rate.rate,
+                      "frames_per_s": self.frames_rate.rate},
+        }
+
+    def _slo_tick(self, steps: int) -> None:
+        """One engine sample per health tick (trainer thread ONLY — the
+        status thread reads snapshots; sampling per scrape would make
+        burn windows a function of scrape traffic).  Transitions print
+        like fleet transitions do and land in the scalar log."""
+        if self.fleet is None:
+            return
+        if self._slo is None:
+            from apex_tpu.obs.slo import SloEngine, default_slos
+            self._slo = SloEngine(default_slos(
+                actor_dead_thresh=getattr(self.cfg.comms,
+                                          "relax_floor_dead_frac", None)))
+        for tr in self._slo.sample(self._slo_signals()):
+            print(f"slo: {tr['objective']} {tr['from']} -> {tr['to']} "
+                  f"(value={tr['value']})", flush=True)
+            self.log.scalars(
+                {f"slo_{tr['to'].lower()}_transition": 1.0}, steps)
 
     def fleet_summary(self) -> dict | None:
         """Registry snapshot + wire counters (the e2e bench ``fleet``
@@ -651,6 +708,21 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # chunk/frame counters — the anakin-smoke CI drill asserts
             # these are nonzero from the persisted summary
             m["ondevice"] = ondevice()
+        # SLO signal space + verdicts (apex_tpu/obs/slo): the sections
+        # the engine judges ride the summary so an objective's signal
+        # path resolves identically against the live engine, the status
+        # snapshot, and the persisted JSON a soak/drill asserts on.
+        # steps/ingested live HERE (not only in the disk dump) so the
+        # soak's status-port samples can difference real progress.
+        snap["steps"] = self.steps_rate.total
+        snap["ingested"] = self.ingested
+        snap["rates"] = {"steps_per_s": self.steps_rate.rate,
+                         "frames_per_s": self.frames_rate.rate}
+        lat = self.latency_summary()
+        if lat is not None:
+            snap["latency"] = lat
+        if self._slo is not None:
+            snap["slo"] = self._slo.snapshot()
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {
@@ -676,8 +748,6 @@ class ConcurrentTrainer(CheckpointableTrainer):
         import json
         import os
         summary = self.fleet_summary()
-        summary["steps"] = self.steps_rate.total
-        summary["ingested"] = self.ingested
         path = os.path.join(logdir, "fleet_summary.json")
         try:
             os.makedirs(logdir, exist_ok=True)
@@ -700,21 +770,34 @@ class ConcurrentTrainer(CheckpointableTrainer):
         """Close the registry loop: when the DEAD fraction of the actor
         fleet reaches the config threshold, relax the replay-ratio floor
         (survivors must not be throttled against a throughput target the
-        dead capacity was part of); restore it as peers rejoin.  The
-        reaction is hysteresis-free on purpose — the registry's own
-        SUSPECT window already debounces flapping peers."""
+        dead capacity was part of); restore it as peers rejoin.
+
+        The reaction consults the SLO engine's actor-capacity alert
+        (which judges the SAME threshold — default_slos wires
+        relax_floor_dead_frac into the ``actor_dead_frac`` objective),
+        so the two surfaces cannot disagree: while that alert is
+        BREACHED the floor stays relaxed even if the instantaneous
+        fraction dips under the bar mid-flap — the alert's own
+        resolve damping is the hysteresis, the raw threshold keeps the
+        reaction instant on a fresh mass death."""
         thresh = getattr(self.cfg.comms, "relax_floor_dead_frac", None)
         if (thresh is None or self.fleet is None
                 or self.min_train_ratio is None):
             return
         frac = self.fleet.dead_fraction(roles=("actor",))
-        if not self._floor_relaxed and frac >= thresh:
+        slo_breached = (self._slo is not None
+                        and self._slo.state_of("actor_dead_frac")
+                        == "BREACHED")
+        fire = frac >= thresh or slo_breached
+        if not self._floor_relaxed and fire:
             self._floor_relaxed = True
             self.floor_relaxes += 1
-            print(f"fleet reaction: {frac:.0%} of actor capacity DEAD — "
-                  f"relaxing the replay-ratio floor "
-                  f"(min_train_ratio={self.min_train_ratio})", flush=True)
-        elif self._floor_relaxed and frac < thresh:
+            why = (f"{frac:.0%} of actor capacity DEAD" if frac >= thresh
+                   else "actor-capacity SLO BREACHED")
+            print(f"fleet reaction: {why} — relaxing the replay-ratio "
+                  f"floor (min_train_ratio={self.min_train_ratio})",
+                  flush=True)
+        elif self._floor_relaxed and not fire:
             self._floor_relaxed = False
             print(f"fleet reaction: actor capacity back "
                   f"({frac:.0%} DEAD) — replay-ratio floor restored",

@@ -102,10 +102,32 @@ fi
 if [ "$REMOTE_POLICY" = "1" ]; then
   # the infer server skips the startup barrier (useful the moment its
   # ROUTER binds); launch before the actors so their first vector steps
-  # already batch centrally instead of burning one fallback wait each
-  python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
+  # already batch centrally instead of burning one fallback wait each.
+  # APEX_SUPERVISE_INFER=1 wraps it in the host supervisor so a
+  # chaos-killed server respawns in seconds (the kill disarms on the
+  # supervised life) and the SLO engine's round-trip alert can walk the
+  # full BREACHED -> RESOLVED cycle — the slo-smoke drill's topology.
+  if [ "${APEX_SUPERVISE_INFER:-0}" = "1" ]; then
+    python -m apex_tpu.fleet.supervise --min-uptime 1 \
+      --backoff 0.5 --backoff-max 2 -- \
+      python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
+  else
+    python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
+  fi
   pids+=($!)
 fi
+
+# SLO soak traffic (apex_tpu/obs/soak.py): APEX_LOADGEN=N spawns N
+# standalone on-device loadgen roles (jittable envs only — the CLI fails
+# loud otherwise) that saturate the chunk plane at device rate.  They
+# skip the startup barrier like replay/infer roles, so they are NOT
+# counted in --n-actors.
+LOADGEN="${APEX_LOADGEN:-0}"
+for g in $(seq 0 $((LOADGEN - 1))); do   # LOADGEN=0: no loadgen roles
+  python -m apex_tpu.runtime --role loadgen --actor-id "$g" \
+    "${COMMON[@]}" &
+  pids+=($!)
+done
 
 for i in $(seq 0 $((N_ACTORS - 1))); do   # N_ACTORS=0: no host actors
   python -m apex_tpu.runtime --role actor --actor-id "$i" \

@@ -1236,6 +1236,77 @@ def test_j014_silent_on_jnp_in_scan_body():
         """, "J014")
 
 
+# -- J015: literal gauge/family names outside the metric registry ------------
+
+def test_j015_fires_on_unregistered_heartbeat_gauge_key():
+    findings = run_rule("""
+        from apex_tpu.fleet.heartbeat import Heartbeat
+        def beat():
+            return Heartbeat("infer-0", gauges={"queue_depth": 1,
+                                                "totally_novel_gauge": 2})
+        """, "J015")
+    assert len(findings) == 1
+    assert "totally_novel_gauge" in findings[0].message
+
+
+def test_j015_fires_on_gauges_fn_lambda_and_named_hook():
+    # the run_loadgen shape: gauges_fn=lambda returning a literal dict
+    assert fires("""
+        from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+        def loop():
+            beat = HeartbeatEmitter(
+                "loadgen-0", gauges_fn=(lambda: {"bogus_counter": 1}))
+        """, "J015")
+    # the anakin shape: gauges_fn=self.method, method returns a literal
+    assert fires("""
+        from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+        class Pool:
+            def my_counters(self):
+                return {"not_in_registry": 3}
+            def start(self):
+                self.hb = HeartbeatEmitter(
+                    "x", gauges_fn=self.my_counters)
+        """, "J015")
+
+
+def test_j015_fires_on_unregistered_exposition_family():
+    assert fires("""
+        from apex_tpu.obs.metrics import render
+        def expo():
+            labeled = {"my_adhoc_family": [({"x": "y"}, 1.0)]}
+            return render(labeled=labeled)
+        """, "J015")
+
+
+def test_j015_silent_on_registered_keys_and_dynamic_names():
+    # every key declared in the registry: the normal emitter shape
+    assert not fires("""
+        from apex_tpu.fleet.heartbeat import Heartbeat
+        def beat(depth):
+            return Heartbeat("infer-0", gauges={"queue_depth": depth,
+                                                "batch_p50": 1.5,
+                                                "infer_rt_ms_p99": 2.0})
+        """, "J015")
+    # dynamic keys are not literal dataflow — scalar tails, per-peer
+    # dicts, comprehensions all pass through untouched
+    assert not fires("""
+        from apex_tpu.obs.metrics import render
+        def expo(history):
+            gauges = {tag: dq[-1] for tag, dq in history.items()}
+            counters = dict(build_counters())
+            return render(gauges=gauges, counters=counters)
+        """, "J015")
+
+
+def test_j015_silent_on_gauge_keys_in_plain_dicts():
+    # a dict literal that never flows into a gauges/exposition sink is
+    # just a dict — the rule follows sinks, not spellings
+    assert not fires("""
+        def stats():
+            return {"anything_goes_here": 1, "free_form": 2}
+        """, "J015")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

@@ -564,6 +564,7 @@ def test_prometheus_render_sections():
         h.record(v)
     text = obs_metrics.render(
         gauges={"learner/loss": 0.25, "skipped": None},
+        # apexlint: disable=J015 -- synthetic family name exercising the renderer
         counters={"steps_total": 123},
         histograms={"frame_age_at_train_seconds": h.snapshot()},
         labeled={"fleet_peer_fps": [({"identity": "actor-0"}, 55.0)]})

@@ -1,0 +1,516 @@
+"""Fleet SLO engine (apex_tpu/obs/slo), soak artifact, scale parity.
+
+Everything time-like runs under fake clocks — the engine's burn windows
+and alert damping are pure functions of (verdict stream, clock), so the
+transitions pinned here are deterministic by construction.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs.slo import (BREACHED, BURNING, OK, RESOLVED, SloEngine,
+                              SloKnobs, SloObjective, check_regression,
+                              default_slos, format_slo_lines,
+                              knobs_from_env, prometheus_sections,
+                              resolve_signal)
+from apex_tpu.obs.slo import main as slo_main
+from apex_tpu.obs.soak import build_artifact, make_sample, offered_frames
+
+# -- signal resolution -------------------------------------------------------
+
+SUMMARY = {
+    "peers": [
+        {"role": "actor", "state": "ALIVE", "fps": 10.0,
+         "gauges": {"infer_rt_ms_p99": 12.0}},
+        {"role": "actor", "state": "DEAD", "fps": 99.0,
+         "gauges": {"infer_rt_ms_p99": 500.0}},
+        {"role": "infer", "state": "ALIVE", "fps": 0.0, "gauges": {}},
+        {"role": "evaluator", "state": "ALIVE", "fps": 0.0,
+         "gauges": {"eval_score_mean": 0.8}},
+    ],
+    "metrics": {"dead_actor_frac": 0.5},
+    "latency": {"frame_age_at_train_s": {"p99_s": 3.2}},
+    "rates": {"steps_per_s": 4.0, "frames_per_s": 80.0},
+}
+
+
+def test_resolve_signal_forms():
+    # gauge aggregation excludes DEAD peers (their last values are stale
+    # by definition — a dead peer must not pin the fleet's p99)
+    assert resolve_signal(SUMMARY, "gauge:actor:infer_rt_ms_p99:max") \
+        == 12.0
+    assert resolve_signal(SUMMARY,
+                          "gauge:evaluator:eval_score_mean:min") == 0.8
+    assert resolve_signal(SUMMARY, "gauge:actor:nonexistent:max") is None
+    # derived dead fractions, per role and fleet-wide
+    assert resolve_signal(SUMMARY, "derived.dead_frac.actor") == 0.5
+    assert resolve_signal(SUMMARY, "derived.dead_frac.infer") == 0.0
+    assert resolve_signal(SUMMARY, "derived.dead_frac.all") == 0.25
+    assert resolve_signal(SUMMARY, "derived.dead_frac.loadgen") is None
+    assert resolve_signal(SUMMARY, "derived.role_fps.actor") == 10.0
+    # dotted walks; dicts and missing leaves resolve to None, never raise
+    assert resolve_signal(SUMMARY, "metrics.dead_actor_frac") == 0.5
+    assert resolve_signal(SUMMARY,
+                          "latency.frame_age_at_train_s.p99_s") == 3.2
+    assert resolve_signal(SUMMARY, "rates.steps_per_s") == 4.0
+    assert resolve_signal(SUMMARY, "rates.missing") is None
+    assert resolve_signal(SUMMARY, "latency") is None
+    assert resolve_signal({}, "gauge:actor:x:max") is None
+
+
+# -- the engine under fake clocks --------------------------------------------
+
+KNOBS = SloKnobs(fast=(10.0, 30.0), slow=(60.0, 120.0), page_burn=10.0,
+                 warn_burn=3.0, breach_after_s=4.0, resolve_after_s=10.0,
+                 ok_after_s=15.0, min_samples=2)
+
+
+def _engine(threshold=100.0, op="<=", knobs=KNOBS, grace_s=0.0):
+    t = {"now": 0.0}
+    obj = SloObjective("rt", "rates.rt", threshold, op, grace_s=grace_s)
+    eng = SloEngine([obj], knobs=knobs, clock=lambda: t["now"],
+                    wall=lambda: 1_000_000.0 + t["now"])
+    return eng, t
+
+
+def _feed(eng, t, values, dt=5.0):
+    """One sample per value, ticking the fake clock dt apart."""
+    events = []
+    for v in values:
+        events += eng.sample({"rates": {"rt": v}})
+        t["now"] += dt
+    return events
+
+
+def test_burn_rate_math():
+    eng, t = _engine()
+    # below min_samples: no judgment yet
+    _feed(eng, t, [10.0])
+    o = eng.snapshot()["objectives"][0]
+    assert o["burn_fast"] is None and o["state"] == OK
+    # 2 good + 2 bad in the 30s window: bad_frac 0.5 / budget 0.01 = 50
+    _feed(eng, t, [10.0, 500.0, 500.0])
+    o = eng.snapshot()["objectives"][0]
+    assert o["burn_fast"] == pytest.approx(50.0)
+    assert o["value"] == 500.0
+    assert o["verdicts"] == 4
+    assert o["compliance_pct"] == 50.0
+
+
+def test_alert_cycle_ok_burning_breached_resolved_ok():
+    eng, t = _engine()
+    _feed(eng, t, [10.0, 10.0, 10.0])            # healthy baseline
+    assert eng.state_of("rt") == OK
+
+    # sustained violation: page fires (both fast windows), then the
+    # breach_after damping window elapses -> BREACHED
+    events = _feed(eng, t, [500.0, 500.0, 500.0, 500.0])
+    states = [(e["from"], e["to"]) for e in events]
+    assert (OK, BURNING) in states
+    assert (BURNING, BREACHED) in states
+    assert eng.state_of("rt") == BREACHED
+    assert eng.severity() == 2
+
+    # recovery: quiet must SUSTAIN resolve_after_s before RESOLVED,
+    # then ok_after_s more before OK — no strobing
+    events = _feed(eng, t, [10.0] * 10)
+    states = [(e["from"], e["to"]) for e in events]
+    assert (BREACHED, RESOLVED) in states
+    assert (RESOLVED, OK) in states
+    assert eng.state_of("rt") == OK
+    # the slow-window WARN outlives the page: the budget spent during
+    # the breach still burns above warn rate until it ages out
+    assert eng.severity() == 1
+    _feed(eng, t, [10.0] * 20)
+    assert eng.severity() == 0
+
+    # the bounded timeline recorded the full cycle in order
+    tl = [(e["from"], e["to"]) for e in eng.snapshot()["timeline"]]
+    assert tl == [(OK, BURNING), (BURNING, BREACHED),
+                  (BREACHED, RESOLVED), (RESOLVED, OK)]
+    snap = eng.snapshot()["objectives"][0]
+    assert snap["breaches"] == 1
+
+
+def test_flap_damping_transient_spike_never_pages():
+    # breach_after of 12s = three 5s ticks of sustained burn; a single
+    # bad tick visits BURNING and falls back to OK without ever paging
+    knobs = SloKnobs(fast=(10.0, 30.0), slow=(60.0, 120.0),
+                     page_burn=10.0, warn_burn=3.0, breach_after_s=12.0,
+                     resolve_after_s=10.0, ok_after_s=15.0,
+                     min_samples=2)
+    eng, t = _engine(knobs=knobs)
+    _feed(eng, t, [10.0, 10.0, 500.0, 10.0, 10.0, 10.0, 10.0])
+    assert eng.state_of("rt") == OK
+    o = eng.snapshot()["objectives"][0]
+    assert o["breaches"] == 0
+    tl = [(e["from"], e["to"]) for e in eng.snapshot()["timeline"]]
+    assert (BURNING, BREACHED) not in tl
+
+
+def test_observe_only_and_grace_record_no_verdicts():
+    eng, t = _engine(threshold=None)              # observe-only
+    _feed(eng, t, [500.0] * 5)
+    o = eng.snapshot()["objectives"][0]
+    assert o["state"] == OK and o["verdicts"] == 0
+    assert o["value"] == 500.0 and o["enabled"] is False
+
+    eng, t = _engine(grace_s=11.0)                # warmup grace
+    _feed(eng, t, [500.0, 500.0, 500.0, 500.0])   # ticks at 0/5/10/15
+    o = eng.snapshot()["objectives"][0]
+    assert o["verdicts"] == 1                     # only the post-grace tick
+
+
+def test_idle_needs_zero_burn_over_slow_window():
+    eng, t = _engine()
+    _feed(eng, t, [10.0, 10.0, 10.0])
+    assert eng.snapshot()["idle"] is True
+    _feed(eng, t, [500.0])
+    assert eng.snapshot()["idle"] is False        # budget was burned
+    # ...and stays non-idle until the bad verdict ages out of the slow
+    # window (120s = 24 ticks), not merely until the state recovers
+    _feed(eng, t, [10.0] * 10)
+    assert eng.state_of("rt") == OK
+    assert eng.snapshot()["idle"] is False
+    _feed(eng, t, [10.0] * 20)
+    assert eng.snapshot()["idle"] is True
+
+
+def test_default_slos_env_twins_and_threshold_sharing():
+    names = {o.name for o in default_slos()}
+    assert {"infer_rt_p99_ms", "frame_age_p99_s", "param_lag_p99_s",
+            "learner_steps_rate", "fleet_frames_rate", "actor_fps",
+            "dead_peer_frac", "actor_dead_frac", "infer_up",
+            "eval_score"} <= names
+    by = {o.name: o for o in default_slos(
+        actor_dead_thresh=0.25,
+        environ={"APEX_SLO_INFER_RT_MS": "off",
+                 "APEX_SLO_FRAME_AGE_S": "33"})}
+    assert by["infer_rt_p99_ms"].threshold is None    # disabled
+    assert by["frame_age_p99_s"].threshold == 33.0
+    # the floor reaction and the SLO judge the SAME bar by construction
+    assert by["actor_dead_frac"].threshold == 0.25
+
+    k = knobs_from_env({"APEX_SLO_FAST": "10,30",
+                        "APEX_SLO_BREACH_AFTER": "4"})
+    assert k.fast == (10.0, 30.0) and k.breach_after_s == 4.0
+    assert k.slow == SloKnobs.slow                    # untouched default
+
+
+# -- scale decisions: drain-frac vs slo parity -------------------------------
+
+def test_scale_decision_parity_drain_vs_slo():
+    from apex_tpu.fleet.supervise import (scale_decision,
+                                          scale_decision_slo)
+
+    # same decision table, two signals: capacity-short -> up,
+    # over-provisioned -> down, ambiguous/unreadable -> hold, clamped
+    breached = {"severity": 2, "idle": False}
+    burning = {"severity": 1, "idle": False}
+    idle = {"severity": 0, "idle": True}
+    okay = {"severity": 0, "idle": False}
+    assert scale_decision_slo(breached, 2, 1, 8) == 3 \
+        == scale_decision(0.05, 2, 1, 8)              # up
+    assert scale_decision_slo(idle, 4, 1, 8) == 3 \
+        == scale_decision(0.9, 4, 1, 8)               # down
+    assert scale_decision_slo(burning, 4, 1, 8) == 4 \
+        == scale_decision(0.3, 4, 1, 8)               # hold
+    assert scale_decision_slo(okay, 4, 1, 8) == 4
+    assert scale_decision_slo(None, 4, 1, 8) == 4 \
+        == scale_decision(None, 4, 1, 8)              # unreadable: hold
+    assert scale_decision_slo(breached, 8, 1, 8) == 8  # ceiling clamp
+    assert scale_decision_slo(idle, 1, 1, 8) == 1      # floor clamp
+
+
+class _FakeChild:
+    def __init__(self, cmd, env):
+        self.cmd, self.env = cmd, env
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.rc = -15
+
+
+def test_scale_supervisor_slo_signal_changes_fleet_size():
+    """The acceptance pin: --scale-signal slo demonstrably resizes the
+    fleet on scripted snapshots (breach -> grow, idle -> shrink)."""
+    from apex_tpu.fleet.supervise import (ScaleSupervisor,
+                                          scale_decision_slo)
+
+    snaps = [{"severity": 2, "idle": False},      # breach: up
+             {"severity": 1, "idle": False},      # burning: hold
+             {"severity": 0, "idle": True}]       # idle: down
+    sup = ScaleSupervisor(["serve", "--slot", "{slot}"], n_min=1,
+                          n_max=4, probe=lambda: snaps.pop(0),
+                          spawn=lambda c, e: _FakeChild(c, e),
+                          decide=scale_decision_slo)
+    sup._apply_target()
+    assert sorted(sup.children) == [0]
+    sup.tick()
+    assert sup.target == 2 and sorted(sup.children) == [0, 1]
+    sup.tick()
+    assert sup.target == 2                        # hold under BURNING
+    sup.tick()
+    assert sup.target == 1 and sorted(sup.children) == [0]
+    assert sup.scale_ups == 1 and sup.scale_downs == 1
+
+
+# -- prometheus + status-table surfaces --------------------------------------
+
+def test_prometheus_apex_slo_rows_round_trip():
+    eng, t = _engine()
+    _feed(eng, t, [10.0, 10.0, 500.0, 500.0, 500.0, 500.0])
+    snap = eng.snapshot()
+    gauges, labeled = prometheus_sections(snap)
+    # every family the sections mint is declared in the registry (the
+    # J015 contract, asserted from the emitting side too)
+    for name in list(gauges) + list(labeled):
+        assert name in obs_metrics.REGISTERED_FAMILIES, name
+    text = obs_metrics.render(gauges=gauges, labeled=labeled)
+    assert "# TYPE apex_slo_state gauge" in text
+    assert ('apex_slo_state{objective="rt",state="BREACHED"} 2'
+            in text)
+    assert 'apex_slo_value{objective="rt"} 500.0' in text
+    assert 'apex_slo_breaches{objective="rt"} 1' in text
+    assert 'apex_slo_compliance_pct{objective="rt"}' in text
+    assert "apex_slo_severity 2" in text
+
+
+def test_status_table_carries_slo_lines():
+    from apex_tpu.fleet.registry import format_fleet_table
+
+    eng, t = _engine()
+    _feed(eng, t, [10.0, 10.0, 500.0, 500.0, 500.0])
+    table = format_fleet_table(
+        {"peers": [], "metrics": {}, "slo": eng.snapshot()})
+    assert "slo rt: BREACHED" in table
+    assert "slo severity=2" in table
+    # an engine-less snapshot renders the plain table unchanged
+    assert "slo " not in format_fleet_table({"peers": [], "metrics": {}})
+
+
+def test_format_slo_lines_skips_silent_disabled_objectives():
+    lines = format_slo_lines({"objectives": [
+        {"name": "a", "state": OK, "enabled": False, "value": None,
+         "threshold": None, "op": ">=", "breaches": 0},
+    ], "severity": 0, "idle": True, "ticks": 3})
+    assert lines == []                            # nothing judged, no noise
+
+
+# -- trainer integration -----------------------------------------------------
+
+class _NullPool:
+    procs: list = []
+
+    def start(self):
+        pass
+
+    def cleanup(self):
+        pass
+
+    def poll_chunks(self, n, timeout=0.0):
+        return []
+
+    def poll_stats(self):
+        return []
+
+    def publish_params(self, version, params):
+        pass
+
+
+def test_trainer_slo_tick_sections_and_floor_coupling():
+    """The engine rides the health tick: fleet_summary carries rates/
+    latency/slo sections, and a BREACHED actor-capacity alert relaxes
+    the replay-ratio floor even when the instantaneous dead fraction
+    sits under the raw threshold (flap hysteresis — the two surfaces
+    cannot disagree)."""
+    import dataclasses
+
+    from apex_tpu.config import small_test_config
+    from apex_tpu.fleet.heartbeat import Heartbeat
+    from apex_tpu.fleet.registry import FleetRegistry
+    from apex_tpu.training.apex import ApexTrainer
+
+    cfg = small_test_config()
+    cfg = cfg.replace(comms=dataclasses.replace(
+        cfg.comms, relax_floor_dead_frac=0.5))
+    trainer = ApexTrainer(cfg, pool=_NullPool(), respawn_workers=False,
+                          train_ratio=8.0, min_train_ratio=0.5)
+    trainer.fleet = FleetRegistry(cfg.comms)
+    trainer.fleet.observe(Heartbeat("actor-0", role="actor"))
+    trainer._slo_tick(0)
+    assert trainer._slo is not None
+    # the shared-threshold wiring reached the engine
+    by = {o.name: o for o in trainer._slo.objectives}
+    assert by["actor_dead_frac"].threshold == 0.5
+
+    summary = trainer.fleet_summary()
+    assert "slo" in summary and "rates" in summary
+    assert summary["slo"]["objectives"]
+    assert summary["rates"]["steps_per_s"] == 0.0
+
+    # drive the actor-capacity alert to BREACHED on a scripted engine,
+    # with the REGISTRY healthy: the floor must still relax
+    from apex_tpu.obs.slo import SloEngine, SloObjective
+    t = {"now": 0.0}
+    eng = SloEngine([SloObjective("actor_dead_frac", "metrics.d", 0.5)],
+                    knobs=KNOBS, clock=lambda: t["now"])
+    for _ in range(6):
+        eng.sample({"metrics": {"d": 1.0}})
+        t["now"] += 5.0
+    assert eng.state_of("actor_dead_frac") == BREACHED
+    trainer._slo = eng
+    assert trainer.fleet.dead_fraction() == 0.0   # registry: all alive
+    trainer._react_to_fleet(0)
+    assert trainer._floor_relaxed
+    assert trainer._min_ratio_effective() is None
+
+    # the alert resolving restores the floor
+    for _ in range(12):
+        eng.sample({"metrics": {"d": 0.0}})
+        t["now"] += 5.0
+    assert eng.state_of("actor_dead_frac") == OK
+    trainer._react_to_fleet(0)
+    assert not trainer._floor_relaxed
+
+
+# -- soak artifact schema pin ------------------------------------------------
+
+def _soak_summary(steps, ingested, offered, slo_snap):
+    return {
+        "steps": steps, "ingested": ingested,
+        "peers": [{"role": "loadgen", "state": "ALIVE",
+                   "gauges": {"ondevice_frames": offered}}],
+        "metrics": {"alive": 3, "dead": 0},
+        "rates": {"steps_per_s": 5.0, "frames_per_s": 100.0},
+        "slo": slo_snap,
+    }
+
+
+def test_soak_artifact_schema_and_math():
+    eng, t = _engine()
+    _feed(eng, t, [10.0, 10.0, 500.0, 500.0, 10.0, 10.0])
+    snap = eng.snapshot()
+    samples = [make_sample(_soak_summary(100, 1_000, 10_000, snap), 10.0),
+               make_sample(_soak_summary(200, 3_000, 50_000, snap), 110.0)]
+    meta = {"env_id": "ApexCatchSmall-v0", "budget_s": 120.0,
+            "effective_cores": 1.0}
+    art = build_artifact(meta, samples,
+                         _soak_summary(200, 3_000, 50_000, snap))
+    # schema pin: the standing artifact's shape is a contract — the CI
+    # drill, the --check differ, and future dashboards all read it
+    assert art["kind"] == "apex_soak" and art["version"] == 1
+    assert set(art) == {"kind", "version", "meta", "samples", "slo",
+                        "throughput"}
+    assert set(art["slo"]) == {"compliance", "breaches", "timeline",
+                               "severity_final", "objectives"}
+    assert set(art["throughput"]) == {
+        "steps_final", "ingested_final", "offered_frames_final",
+        "steps_per_s", "ingest_per_s", "offered_per_s", "saturation"}
+    s0 = art["samples"][0]
+    assert {"t_s", "steps", "ingested", "offered_frames", "rates",
+            "severity", "states", "alive", "dead"} <= set(s0)
+    # throughput math over the sampled span
+    assert art["throughput"]["steps_per_s"] == 1.0
+    assert art["throughput"]["ingest_per_s"] == 20.0
+    assert art["throughput"]["offered_per_s"] == 400.0
+    assert art["throughput"]["saturation"] == 20.0
+    # SLO evidence folded in from the engine snapshot
+    assert art["slo"]["compliance"]["rt"] == pytest.approx(66.67)
+    assert art["slo"]["breaches"].get("rt", 0) >= 1
+    assert any(e["to"] == BREACHED for e in art["slo"]["timeline"])
+    # artifact is pure JSON (the file the soak writes round-trips)
+    json.loads(json.dumps(art))
+
+
+def test_offered_frames_sums_loadgen_gauges_only():
+    s = {"peers": [
+        {"role": "loadgen", "gauges": {"ondevice_frames": 100}},
+        {"role": "loadgen", "gauges": {"ondevice_frames": 50}},
+        {"role": "actor", "gauges": {"ondevice_frames": 999}},
+    ]}
+    assert offered_frames(s) == 150
+
+
+# -- the --check regression differ -------------------------------------------
+
+BASE_BENCH = {
+    "part1e": {"remote": {"frames_per_sec": 100.0,
+                          "rt_ms": {"p50": 2.0, "p99": 8.0}},
+               "local": {"frames_per_sec": 110.0}},
+    "latency": {"frame_age_at_train_s": {"p99_s": 10.0, "count": 500}},
+    "effective_cores": 1.0,
+    "platform_note": "cpu",                      # non-numeric: ignored
+}
+
+
+def _cand(**over):
+    cand = json.loads(json.dumps(BASE_BENCH))
+    for path, v in over.items():
+        node = cand
+        parts = path.split("__")
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = v
+    return cand
+
+
+def test_check_regression_direction_and_band():
+    # inside the band: no verdicts beyond ok
+    rows = check_regression(BASE_BENCH, _cand(), tol=0.15)
+    assert rows and all(r["verdict"] == "ok" for r in rows)
+    # lower-better leaf regressing (latency p99 up 50%)
+    rows = check_regression(
+        BASE_BENCH, _cand(latency__frame_age_at_train_s__p99_s=15.0))
+    bad = [r for r in rows if r["verdict"] == "REGRESSED"]
+    assert [r["path"] for r in bad] == \
+        ["latency.frame_age_at_train_s.p99_s"]
+    # higher-better leaf regressing (throughput down 40%)
+    rows = check_regression(
+        BASE_BENCH, _cand(part1e__remote__frames_per_sec=60.0))
+    bad = [r for r in rows if r["verdict"] == "REGRESSED"]
+    assert [r["path"] for r in bad] == ["part1e.remote.frames_per_sec"]
+    # improvements are labeled, never failed
+    rows = check_regression(
+        BASE_BENCH, _cand(part1e__remote__rt_ms__p99=4.0))
+    assert [r["path"] for r in rows if r["verdict"] == "improved"] \
+        == ["part1e.remote.rt_ms.p99"]
+    # "count" is informational, not a lane
+    assert not any("count" in r["path"].rsplit(".", 1)[-1]
+                   for r in check_regression(BASE_BENCH, _cand()))
+
+
+def test_check_cli_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b_ok = tmp_path / "b_ok.json"
+    b_bad = tmp_path / "b_bad.json"
+    a.write_text(json.dumps(BASE_BENCH))
+    b_ok.write_text(json.dumps(_cand()))
+    b_bad.write_text(json.dumps(
+        _cand(latency__frame_age_at_train_s__p99_s=30.0)))
+    assert slo_main(["--check", str(a), str(b_ok)]) == 0
+    out = capsys.readouterr().out
+    assert "0 regressed" in out
+    assert slo_main(["--check", str(a), str(b_bad)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "frame_age_at_train_s.p99_s" in out
+    # machine-readable mode round-trips
+    assert slo_main(["--check", str(a), str(b_bad), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["regressed"] == 1 and doc["compared"] >= 4
+    # a widened band forgives the same pair
+    assert slo_main(["--check", str(a), str(b_bad), "--tol", "3.0"]) == 0
+    capsys.readouterr()
+
+
+def test_objective_table_prints_without_args(capsys):
+    assert slo_main([]) == 0
+    out = capsys.readouterr().out
+    assert "infer_rt_p99_ms" in out and "burn windows" in out
