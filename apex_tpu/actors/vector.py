@@ -504,10 +504,13 @@ def vector_worker_main(actor_id: int, cfg: ApexConfig, model_spec: dict,
         cfg, model_spec, seeds=seeds, slot_ids=slot_ids, epsilons=epsilons,
         chunk_transitions=chunk_transitions)
     if getattr(cfg.actor, "remote_policy", False):
-        # centralized inference: the half-group policy calls ship to the
-        # infer server; the family's local jit stays as the fallback
-        from apex_tpu.infer_service.client import InferClient
-        family.attach_infer(InferClient(cfg.comms, f"actor-{actor_id}"))
+        # centralized inference: the half-group policy calls ship to this
+        # worker's home infer shard (identity-hashed — serving/shard.py;
+        # one shard IS the PR 9 single server); the family's local jit
+        # stays as the fallback
+        from apex_tpu.serving.shard import make_infer_client
+        family.attach_infer(make_infer_client(cfg.comms,
+                                              f"actor-{actor_id}"))
     vector_worker_loop(actor_id, cfg, family, chunk_queue, param_queue,
                        stat_queue, stop_event)
 

@@ -831,3 +831,71 @@ class UnregisteredGauge(Rule):
                     self._check_keys(ctx, d, families_reg,
                                      "exposition family", out)
         return out
+
+
+# -- J016 -------------------------------------------------------------------
+
+
+@register
+class RawEpochComparison(Rule):
+    id = "J016"
+    name = "raw-epoch-comparison"
+    description = ("an ordering comparison (<, <=, >, >=) on a "
+                   "learner_epoch/param_version attribute outside the "
+                   "model-version fencing helpers (apex_tpu/serving/"
+                   "fence.py): model versions order as the lexicographic "
+                   "(epoch, version) pair — epoch-major — and a scattered "
+                   "raw comparison is how a rollback path serves a dead "
+                   "life's params or rejects a restored incumbent as "
+                   "stale.  Route the comparison through "
+                   "apex_tpu.serving.fence (fence_key/beyond/"
+                   "newer_epoch/stale_epoch)")
+
+    #: the fenced names — the wire-visible model-version components
+    _NAMES = frozenset({"learner_epoch", "param_version"})
+    #: THE fencing helper module: the one place raw ordering may live
+    _EXEMPT = ("apex_tpu/serving/fence.py", "serving/fence.py")
+
+    @staticmethod
+    def _fenced_name(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute):
+            return (node.attr
+                    if node.attr in RawEpochComparison._NAMES else None)
+        if isinstance(node, ast.Name):
+            return (node.id
+                    if node.id in RawEpochComparison._NAMES else None)
+        return None
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        import os as _os
+        path = ctx.path.replace(_os.sep, "/")
+        if path.endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not any(isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                       for op in node.ops):
+                continue            # ==/!= identity checks are fine
+            comparands = (node.left, *node.comparators)
+            if all(self._fenced_name(c) is not None
+                   or isinstance(c, ast.Constant) for c in comparands) \
+                    and any(isinstance(c, ast.Constant)
+                            for c in comparands):
+                # ordering against a LITERAL (`param_version >= 2`, the
+                # test-suite progress assertions) cannot smuggle a dead
+                # life's value — the hazard is ordering two epoch/
+                # version VARIABLES across lifetimes
+                continue
+            for comparand in comparands:
+                name = self._fenced_name(comparand)
+                if name is not None:
+                    out.append(ctx.finding(
+                        self, node,
+                        f"ordering comparison on '{name}' outside the "
+                        f"fencing helpers — epochs/versions order as the "
+                        f"(epoch, version) pair; use "
+                        f"apex_tpu.serving.fence"))
+                    break           # one finding per comparison
+        return out

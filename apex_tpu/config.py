@@ -328,6 +328,17 @@ class CommsConfig:
     # device-to-device copy path; skipped automatically on the CPU
     # backend (same gate as the ingest pipeline's staging ring).
     infer_device_params: bool = False
+    # -- sharded serving tier (apex_tpu/serving) ---------------------------
+    # N infer servers, shard s binding infer_port + s (the replay
+    # service's port-base discipline); remote-policy workers route to a
+    # home shard by a stable identity hash (serving/shard.py), each
+    # shard keeping the single-server down-marker/fallback/re-probe
+    # semantics.  1 (default) IS the PR 9 topology — one server on
+    # infer_port.  The whole fleet must agree, so it rides COMMON like
+    # the ports.  The `--role serve-ctl` deployment controller canaries
+    # new model versions onto a shard fraction via the servers'
+    # epoch-fenced param gate (serving/deploy.py).
+    infer_shards: int = 1
 
 
 @dataclass(frozen=True)

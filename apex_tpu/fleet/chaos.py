@@ -20,9 +20,15 @@ Spec (``CHAOS_SPEC``, JSON; every key optional)::
      "mute": ["replay-0"],                      # directional link drop:
      #   the named role's OUTGOING replies vanish (its ingress stays up —
      #   actor->shard up while shard->learner down)
-     "epoch_skew": {"learner": -1}}             # learner-epoch fencing:
+     "epoch_skew": {"learner": -1},             # learner-epoch fencing:
      #   skew this identity's outgoing replay write-back epochs (negative
      #   = stale: shards must reject, count, and stay uncorrupted)
+     "score_bias": {"evaluator": {"after_s": 60, "delta": -100.0}}}
+     #   model-quality regression injection (the serving tier's canary
+     #   drills): after after_s of the evaluator's run, every reported
+     #   episode score shifts by delta — the eval-ladder gauges and the
+     #   eval_score SLO see a degraded model, deterministically.  Keys
+     #   match by PREFIX (evaluator identities carry a uuid suffix).
 
 Determinism: one RNG draw per message, streamed from
 ``seed ^ crc32(identity)``, so a message's fate depends only on (seed,
@@ -72,6 +78,10 @@ class ChaosPlan:
     mute_replies: bool = False
     # learner-epoch skew applied to outgoing replay write-backs
     epoch_skew: int = 0
+    # evaluator score bias (canary drills): reported episode scores
+    # shift by delta once after_s of the role's run has elapsed
+    score_bias_after_s: float | None = None
+    score_bias_delta: float = 0.0
 
     def rng(self) -> random.Random:
         return random.Random(self.seed ^ zlib.crc32(self.identity.encode()))
@@ -90,6 +100,15 @@ class ChaosConfig:
         if self.respawn_count > 0:
             kill = None             # kills are first-life only (see above)
         aw = self.spec.get("ack_withhold") or {}
+        # score_bias keys match by PREFIX: evaluator identities carry a
+        # random uuid suffix ("evaluator-0-ab12cd"), so the spec names
+        # the stable stem ("evaluator" / "evaluator-0")
+        sb = None
+        for key, entry in sorted((self.spec.get("score_bias")
+                                  or {}).items()):
+            if identity.startswith(key):
+                sb = entry
+                break
         return ChaosPlan(
             seed=self.seed, identity=identity,
             kill_at=kill,
@@ -103,7 +122,11 @@ class ChaosConfig:
             ack_withhold_s=float(aw.get("hold_s", 3.0)),
             mute_replies=identity in self.spec.get("mute", ()),
             epoch_skew=int(self.spec.get("epoch_skew", {})
-                           .get(identity, 0)))
+                           .get(identity, 0)),
+            score_bias_after_s=(None if sb is None
+                                else float(sb.get("after_s", 0.0))),
+            score_bias_delta=(0.0 if sb is None
+                              else float(sb.get("delta", 0.0))))
 
 
 def chaos_from_env(environ=None) -> ChaosConfig | None:

@@ -294,6 +294,13 @@ def format_fleet_table(snapshot: dict) -> str:
     if slo:
         from apex_tpu.obs.slo import format_slo_lines
         lines.extend(format_slo_lines(slo))
+    # serving tier (apex_tpu/serving): the canary machine, per-shard
+    # pins, and the tail of the deployment timeline — the operator
+    # table answers "what model is each shard serving" directly
+    serving = snapshot.get("serving")
+    if serving:
+        from apex_tpu.serving.deploy import format_serving_lines
+        lines.extend(format_serving_lines(serving))
     return "\n".join(lines)
 
 

@@ -66,20 +66,26 @@ class InferClient:
 
     def __init__(self, comms: CommsConfig, identity: str,
                  infer_ip: str | None = None, wait_s: float | None = None,
-                 reprobe_s: float | None = None, clock=time.monotonic):
+                 reprobe_s: float | None = None, clock=time.monotonic,
+                 port: int | None = None):
         import zmq
 
         self._zmq = zmq
         self.comms = comms
         self.identity = identity
         self._clock = clock
+        # sharded serving tier (apex_tpu/serving/shard): the home-shard
+        # index make_infer_client stamps after construction — 0 for the
+        # PR 9 single-server topology, surfaced in gauges() so fallback/
+        # stale counts attribute to the shard that caused them
+        self.shard = 0
         self.sock = zmq.Context.instance().socket(zmq.DEALER)
         self.sock.setsockopt(zmq.IDENTITY, f"{identity}-infer".encode())
         # bounded send queue: requests to a dead server must fail fast
         # into the local fallback, not pile up in a kernel buffer
         self.sock.setsockopt(zmq.SNDHWM, 16)
         ip = infer_ip or comms.infer_ip
-        self.sock.connect(f"tcp://{ip}:{comms.infer_port}")
+        self.sock.connect(f"tcp://{ip}:{port or comms.infer_port}")
         self.wait_s = (comms.infer_wait_s if wait_s is None
                        else float(wait_s))
         self.reprobe_s = (comms.infer_reprobe_s if reprobe_s is None
@@ -235,9 +241,15 @@ class InferClient:
         """Actor-heartbeat gauges: the registry/status/Prometheus view of
         this worker's remote-policy health."""
         rt = self.round_trip.snapshot()
-        return {"infer_remote": self.remote_steps,
+        # infer_shard makes the per-shard story legible fleet-wide: the
+        # status table groups each worker's fallback/stale counts under
+        # its home shard, so a mis-pinned or dead shard is visible in
+        # `--role status` instead of only in local counters
+        return {"infer_shard": self.shard,
+                "infer_remote": self.remote_steps,
                 "infer_fallbacks": self.fallbacks,
                 "infer_stale_epoch": self.stale_epoch,
+                "infer_epoch_seen": self.epoch_seen,
                 "infer_reprobes": self.reprobes,
                 "infer_rt_ms_p50": round(rt["p50_s"] * 1000.0, 3),
                 "infer_rt_ms_p90": round(rt["p90_s"] * 1000.0, 3),

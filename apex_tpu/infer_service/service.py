@@ -62,6 +62,7 @@ from apex_tpu.config import ApexConfig, CommsConfig
 from apex_tpu.obs import spans as obs_spans
 from apex_tpu.obs.spans import LatencyHistogram
 from apex_tpu.runtime import wire
+from apex_tpu.serving import fence
 
 
 def quantize_pow2(n: int, cap: int) -> int:
@@ -132,7 +133,8 @@ class InferServer:
     contract the replay shards keep (and apexlint J013 now enforces)."""
 
     def __init__(self, comms: CommsConfig, policy_fn, server_id: int = 0,
-                 bind_ip: str = "*", heartbeat: bool = True, sub=None):
+                 bind_ip: str = "*", heartbeat: bool = True, sub=None,
+                 port: int | None = None):
         import zmq
 
         from apex_tpu.fleet.chaos import chaos_from_env
@@ -142,8 +144,9 @@ class InferServer:
         self.server_id = int(server_id)
         self.identity = f"infer-{server_id}"
         self.batched = make_batched_policy(policy_fn)
+        self.port = int(port) if port is not None else comms.infer_port
         self.sock = zmq.Context.instance().socket(zmq.ROUTER)
-        self.sock.bind(f"tcp://{bind_ip}:{comms.infer_port}")
+        self.sock.bind(f"tcp://{bind_ip}:{self.port}")
         # params: latest-wins off the learner channel (``sub``), or
         # injected via set_params (tests/bench drive the server without a
         # learner).  Device placement is flag-gated and CPU-exempt.
@@ -152,6 +155,16 @@ class InferServer:
         self.param_version = 0
         self.learner_epoch = 0
         self._place = bool(comms.infer_device_params)
+        # serving-tier version gate (apex_tpu/serving/deploy drives it
+        # over the ctl channel): while ``_pin`` holds a model fence,
+        # installs BEYOND it are held (counted) and the shard keeps
+        # serving what it has; ``_incumbent`` retains the pre-canary
+        # params so a rollback restores them bit-identically.
+        self._pin: tuple | None = None
+        self._incumbent: tuple | None = None    # (version, params, epoch)
+        self.held = 0                   # installs refused by the pin
+        self.gate_rollbacks = 0         # incumbent restores taken
+        self.ctl_cmds = 0
         # serving counters / gauges (heartbeats + stats())
         self.requests = 0
         self.replies = 0
@@ -183,11 +196,106 @@ class InferServer:
 
     def set_params(self, version: int, params, epoch: int = 0) -> None:
         """Install params directly (tests, bench, co-located trainers);
-        the serving path is identical to subscriber-fed params."""
+        the serving path is identical to subscriber-fed params.  The
+        epoch-fenced gate applies HERE — pinned shards hold (count)
+        installs beyond the fence, so subscriber and direct installs
+        obey one deployment discipline."""
+        eff_epoch = int(epoch) if epoch else self.learner_epoch
+        if self._pin is not None and fence.beyond(eff_epoch, version,
+                                                  self._pin):
+            self.held += 1
+            return
         self.params = self._placed(params)
         self.param_version = int(version)
         if epoch:
             self.learner_epoch = int(epoch)
+
+    # -- the serving-tier ctl channel (apex_tpu/serving/deploy) -------------
+
+    def apply_ctl(self, body: dict) -> dict:
+        """One deployment-controller command, applied on the socket
+        thread (the gate and the dispatch order can never race).  All
+        commands are idempotent — the controller RECONCILES every tick,
+        so a respawned shard re-converges without special casing.
+
+        * ``freeze``: stash current params (once) and pin at the
+          shard's OWN current fence — the steady-state verb: the tier
+          serves frozen, judged models, never the raw stream.
+        * ``pin``: hold installs beyond an explicit (epoch, version)
+          fence.
+        * ``canary``: stash current params as the incumbent (once) and
+          track the live stream.
+        * ``rollback``: restore the stashed incumbent bit-identically
+          and pin at ITS fence; a shard with no stash serving beyond
+          the given fence (a respawn that picked up the candidate)
+          drops to dry replies — clients fall back to local acting,
+          never act on the rejected model.
+        * ``promote``: clear pin + stash — the gate opens so the tier
+          takes the newly judged version off the stream (the
+          controller re-freezes next tick).
+        * ``status`` (or anything else): report state only.
+        """
+        cmd = body.get("cmd")
+        self.ctl_cmds += 1
+        f = None
+        if "epoch" in body or "version" in body:
+            f = fence.fence_key(body.get("epoch"), body.get("version"))
+        if cmd == "freeze":
+            if self.params is not None and self._incumbent is None:
+                self._incumbent = (self.param_version, self.params,
+                                   self.learner_epoch)
+            self._pin = fence.fence_key(self.learner_epoch,
+                                        self.param_version)
+        elif cmd == "pin" and f is not None:
+            self._pin = f
+        elif cmd == "canary":
+            if self._incumbent is None and self.params is not None:
+                self._incumbent = (self.param_version, self.params,
+                                   self.learner_epoch)
+            self._pin = None
+        elif cmd == "rollback":
+            if self._incumbent is not None:
+                v, p, e = self._incumbent
+                if fence.beyond(self.learner_epoch, self.param_version,
+                                (e, v)):
+                    self.gate_rollbacks += 1    # the restore changed
+                self.params, self.param_version = p, int(v)  # something
+                self.learner_epoch = int(e)
+                self._incumbent = None
+                self._pin = fence.fence_key(e, v)
+            elif self._pin is not None and fence.at_or_before(
+                    self.learner_epoch, self.param_version, self._pin):
+                pass        # already rolled back / frozen pre-candidate
+            elif f is not None and self.params is not None \
+                    and fence.beyond(self.learner_epoch,
+                                     self.param_version, f):
+                # a respawned shard serving the candidate with no stash:
+                # serving it would violate the rollback — serve dry
+                # (clients act locally, bit-identically) until the next
+                # promotion opens the gate
+                self.params = None
+                self._pin = f
+            elif f is not None and self._pin is None:
+                self._pin = f
+        elif cmd == "promote":
+            self._pin = None
+            self._incumbent = None
+        return self.ctl_state(rid=body.get("rid"))
+
+    def ctl_state(self, rid=None) -> dict:
+        """Gate state for ctl replies and stats(): plain builtins."""
+        out = {"shard": self.server_id,
+               "epoch": self.learner_epoch,
+               "version": self.param_version,
+               "pinned": self._pin is not None,
+               "pin": list(self._pin) if self._pin is not None else None,
+               "held": self.held,
+               "rollbacks": self.gate_rollbacks,
+               "has_incumbent": self._incumbent is not None,
+               "has_params": self.params is not None}
+        if rid is not None:
+            out["rid"] = rid
+        return out
 
     def _placed(self, params):
         if not self._place:
@@ -252,8 +360,17 @@ class InferServer:
                 continue                # hostile payload costs its sender
             #                             one fallback wait, nobody else's
             if not (isinstance(got, tuple) and len(got) == 2
-                    and got[0] == "infer" and isinstance(got[1], dict)):
+                    and isinstance(got[1], dict)):
                 self.rejected += 1      # well-pickled garbage included
+                continue
+            if got[0] == "ctl":
+                # deployment-controller command (apex_tpu/serving):
+                # applied here on the one socket thread, outside the
+                # batch window and the chaos request stream
+                self._reply(ident, ("ctl_ok", self.apply_ctl(got[1])))
+                continue
+            if got[0] != "infer":
+                self.rejected += 1
                 continue
             if self.chaos.on_request() == "drop":
                 continue                # unanswered: the client falls back
@@ -336,12 +453,20 @@ class InferServer:
         """The serving gauges heartbeats carry to the registry (status
         table + Prometheus exposition)."""
         b, c = self.batch_hist.snapshot(), self.coalesce_hist.snapshot()
+        # serve_* rows: the registry's per-shard pinned-version view —
+        # the deployment controller's reconcile target is auditable from
+        # `--role status` without a ctl round-trip
         return {"queue_depth": self._queue_depth,
                 "batch_p50": b["p50_s"], "batch_p90": b["p90_s"],
                 "coalesce_ms_p50": round(c["p50_s"] * 1000.0, 3),
                 "requests": self.requests, "replies": self.replies,
                 "dry_replies": self.dry_replies,
-                "rejected": self.rejected}
+                "rejected": self.rejected,
+                "serve_epoch": self.learner_epoch,
+                "serve_version": self.param_version,
+                "serve_pinned": int(self._pin is not None),
+                "serve_held": self.held,
+                "serve_rollbacks": self.gate_rollbacks}
 
     def stats(self) -> dict:
         return {"server": self.server_id,
@@ -350,6 +475,7 @@ class InferServer:
                 "dispatches": self.dispatches,
                 "chaos_dropped": self.chaos.dropped,
                 "chaos_muted": self.chaos_muted,
+                "ctl_cmds": self.ctl_cmds,
                 **self.gauges()}
 
     def close(self) -> None:
@@ -380,19 +506,27 @@ def run_infer_server(cfg: ApexConfig, family: str = "dqn",
     (actors fall back locally until it answers)."""
     from apex_tpu.obs.trace import get_ring, set_process_label
     from apex_tpu.runtime import transport
+    from apex_tpu.serving.shard import shard_port
 
     if family != "dqn":
         raise NotImplementedError(
             f"the inference plane currently serves the dqn family only "
             f"(got {family!r}); aql/r2d2 actors stay on local policies — "
             f"see ROADMAP.md")
+    n_shards = max(1, getattr(cfg.comms, "infer_shards", 1))
+    if not 0 <= server_id < n_shards:
+        raise ValueError(
+            f"infer shard id {server_id} outside [0, {n_shards}) — set "
+            f"--infer-shards/APEX_INFER_SHARDS fleet-wide")
     set_process_label(f"infer-{server_id}")
     get_ring()                      # arm the trace ring's dump triggers
     sub = transport.ParamSubscriber(cfg.comms)
     server = InferServer(cfg.comms, dqn_policy_fn(cfg),
-                         server_id=server_id, bind_ip=bind_ip, sub=sub)
-    print(f"infer-{server_id}: serving on port {cfg.comms.infer_port} "
-          f"(batch_max={cfg.comms.infer_batch_max}, "
+                         server_id=server_id, bind_ip=bind_ip, sub=sub,
+                         port=shard_port(cfg.comms, server_id))
+    print(f"infer-{server_id}: serving on port {server.port} "
+          f"(shard {server_id}/{n_shards}, "
+          f"batch_max={cfg.comms.infer_batch_max}, "
           f"window_ms={cfg.comms.infer_window_ms}, "
           f"device_params={cfg.comms.infer_device_params})", flush=True)
     try:

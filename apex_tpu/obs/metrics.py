@@ -27,10 +27,17 @@ REGISTERED_GAUGES = frozenset({
     # infer server serving gauges (infer_service/service.py)
     "queue_depth", "batch_p50", "batch_p90", "coalesce_ms_p50",
     "requests", "replies", "dry_replies", "rejected",
-    # remote-policy actor health (infer_service/client.py)
+    # remote-policy actor health (infer_service/client.py); infer_shard/
+    # infer_epoch_seen attribute fallback + stale-epoch counts to the
+    # worker's home shard in the sharded serving tier (serving/shard.py)
     "infer_remote", "infer_fallbacks", "infer_stale_epoch",
     "infer_reprobes", "infer_rt_ms_p50", "infer_rt_ms_p90",
-    "infer_rt_ms_p99",
+    "infer_rt_ms_p99", "infer_shard", "infer_epoch_seen",
+    # serving-tier version gate, per shard (infer_service/service.py)
+    # and the deployment controller's own beats (serving/deploy.py)
+    "serve_epoch", "serve_version", "serve_pinned", "serve_held",
+    "serve_rollbacks", "serve_state_code", "serve_deployments",
+    "serve_promotions",
     # on-device rollout planes (training/anakin.py, --role loadgen)
     "ondevice_chunks", "ondevice_frames", "ondevice_dispatches",
     "dispatches", "chunks", "frames", "transitions", "rollout_len",
@@ -57,6 +64,12 @@ REGISTERED_FAMILIES = frozenset({
     # SLO engine rows (obs/slo.py prometheus_sections)
     "slo_severity", "slo_ticks", "slo_state", "slo_value",
     "slo_burn_fast", "slo_breaches", "slo_compliance_pct",
+    # serving-tier deployment rows (serving/deploy.py
+    # prometheus_sections): the canary machine + per-shard pin view
+    "serving_state", "serving_deployments", "serving_promotions",
+    "serving_rollbacks", "serving_canary_shards",
+    "serving_incumbent_epoch", "serving_incumbent_version",
+    "serving_shard_pinned", "serving_shard_version",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")

@@ -98,6 +98,15 @@ class SloObjective:
     fraction (the error budget burn rates divide by).  ``grace_s``
     suppresses verdicts that soon after engine start (rates are honestly
     zero during warmup — alerting on them would page every cold start).
+
+    ``knobs`` (PR 11 carried follow-up) optionally overrides the
+    engine-global burn windows/damping for THIS objective — a
+    :class:`SloKnobOverrides` whose non-None fields win over the engine
+    knobs.  The serving tier's canary gate is the motivating consumer:
+    it wants a much tighter window on ``eval_score`` than on
+    ``frame_age``.  Env twins: ``APEX_SLO_<NAME>_{FAST,SLOW,PAGE_BURN,
+    WARN_BURN,BREACH_AFTER,RESOLVE_AFTER,OK_AFTER,MIN_SAMPLES}`` (name
+    uppercased), parsed by :func:`objective_knobs_from_env`.
     """
 
     name: str
@@ -107,6 +116,7 @@ class SloObjective:
     budget: float = 0.01
     grace_s: float = 0.0
     description: str = ""
+    knobs: "SloKnobOverrides | None" = None
 
     def judge(self, value) -> bool | None:
         """GOOD (True) / BAD (False) / no verdict (None: observe-only
@@ -139,62 +149,77 @@ def default_slos(actor_dead_thresh: float | None = None,
     the replay-ratio-floor reaction judge the SAME bar by construction —
     the two can disagree on timing (the SLO is flap-damped), never on
     the threshold.
+
+    Every objective also reads its per-objective knob env twins
+    (:func:`objective_knobs_from_env`) — unset twins leave the
+    engine-global knobs in charge.
     """
     e = environ if environ is not None else os.environ
+
+    def _obj(name, signal, threshold, op="<=", **kw):
+        return SloObjective(name, signal, threshold, op,
+                            knobs=objective_knobs_from_env(name, e), **kw)
+
     return [
-        SloObjective(
+        _obj(
             "infer_rt_p99_ms", "gauge:actor:infer_rt_ms_p99:max",
             _thr(e, "APEX_SLO_INFER_RT_MS", 250.0), "<=",
             description="worst actor-reported infer round-trip p99 "
                         "(timed-out requests counted at the fallback "
                         "wait — the ROADMAP serving-tier SLO)"),
-        SloObjective(
+        _obj(
             "frame_age_p99_s", "latency.frame_age_at_train_s.p99_s",
             _thr(e, "APEX_SLO_FRAME_AGE_S", 120.0), "<=",
             description="sealed-to-train frame age p99 (PR 6 lineage "
                         "histogram)"),
-        SloObjective(
+        _obj(
             "param_lag_p99_s", "latency.param_propagation_lag_s.p99_s",
             _thr(e, "APEX_SLO_PARAM_LAG_S", 60.0), "<=",
             description="publish-to-trained-experience staleness loop "
                         "p99"),
-        SloObjective(
+        _obj(
             "learner_steps_rate", "rates.steps_per_s",
             _thr(e, "APEX_SLO_STEPS_RATE", 0.01), ">=", grace_s=90.0,
             description="learner update rate floor (a stalled learner "
                         "is an outage, not a quiet one)"),
-        SloObjective(
+        _obj(
             "fleet_frames_rate", "rates.frames_per_s",
             _thr(e, "APEX_SLO_FRAMES_RATE", 0.1), ">=", grace_s=90.0,
             description="fleet-wide ingested-transition rate floor"),
-        SloObjective(
+        _obj(
             "actor_fps", "derived.role_fps.actor",
             _thr(e, "APEX_SLO_ACTOR_FPS", None), ">=", grace_s=90.0,
             description="summed live-actor env fps (observe-only until "
                         "an operator sets the bar for the deployment)"),
-        SloObjective(
+        _obj(
             "dead_peer_frac", "derived.dead_frac.all",
             _thr(e, "APEX_SLO_DEAD_FRAC", 0.5), "<=",
             description="DEAD fraction of the whole registered fleet"),
-        SloObjective(
+        _obj(
             "actor_dead_frac", "metrics.dead_actor_frac",
             (actor_dead_thresh if actor_dead_thresh is not None
              else _thr(e, "APEX_SLO_ACTOR_DEAD_FRAC", 0.5)), "<=",
             description="DEAD fraction of actor capacity — shares its "
                         "threshold with the replay-ratio-floor "
                         "reaction (relax_floor_dead_frac)"),
-        SloObjective(
+        _obj(
             "infer_up", "derived.dead_frac.infer",
             _thr(e, "APEX_SLO_INFER_DEAD", 0.0), "<=",
             description="any DEAD infer server breaches (the serving "
                         "tier has no spare by default)"),
-        SloObjective(
+        _obj(
             "eval_score", "gauge:evaluator:eval_score_mean:min",
             _thr(e, "APEX_SLO_EVAL_SCORE", None), ">=",
             description="worst evaluator-band mean episode score — the "
-                        "model-quality objective the future canary/"
-                        "promotion gate keys off (observe-only until an "
+                        "model-quality objective the serving tier's "
+                        "canary gate keys off (observe-only until an "
                         "operator sets the bar)"),
+        _obj(
+            "serving_rollbacks", "serving.rollbacks",
+            _thr(e, "APEX_SLO_SERVING_ROLLBACKS", None), "<=",
+            description="cumulative serving-tier canary rollbacks "
+                        "(apex_tpu/serving/deploy) — observe-only by "
+                        "default; set 0 to page on ANY rollback"),
     ]
 
 
@@ -299,6 +324,69 @@ def knobs_from_env(environ=None) -> SloKnobs:
                             SloKnobs.min_samples)))
 
 
+@dataclass(frozen=True)
+class SloKnobOverrides:
+    """Per-objective window/damping overrides: non-None fields win over
+    the engine-global :class:`SloKnobs`, everything else inherits — so
+    "tighter eval_score windows for the canary gate" is one field, not a
+    whole parallel knob set."""
+
+    fast: tuple | None = None
+    slow: tuple | None = None
+    page_burn: float | None = None
+    warn_burn: float | None = None
+    breach_after_s: float | None = None
+    resolve_after_s: float | None = None
+    ok_after_s: float | None = None
+    min_samples: int | None = None
+
+
+def objective_knobs_from_env(name: str,
+                             environ=None) -> SloKnobOverrides | None:
+    """Parse ``APEX_SLO_<NAME>_*`` twins (name uppercased) into an
+    overrides record; None when no twin is set (the engine-global knobs
+    stay in charge — the common case)."""
+    e = environ if environ is not None else os.environ
+    prefix = f"APEX_SLO_{name.upper()}_"
+
+    def pair(suffix):
+        v = e.get(prefix + suffix, "")
+        if not v:
+            return None
+        parts = tuple(float(x) for x in v.split(","))
+        return parts if len(parts) == 2 else (parts[0], parts[0])
+
+    def num(suffix):
+        v = e.get(prefix + suffix, "")
+        return None if not v else float(v)
+
+    ms = num("MIN_SAMPLES")
+    over = SloKnobOverrides(
+        fast=pair("FAST"), slow=pair("SLOW"),
+        page_burn=num("PAGE_BURN"), warn_burn=num("WARN_BURN"),
+        breach_after_s=num("BREACH_AFTER"),
+        resolve_after_s=num("RESOLVE_AFTER"),
+        ok_after_s=num("OK_AFTER"),
+        min_samples=None if ms is None else int(ms))
+    if all(getattr(over, f.name) is None
+           for f in over.__dataclass_fields__.values()):
+        return None
+    return over
+
+
+def resolve_knobs(base: SloKnobs, objective: SloObjective) -> SloKnobs:
+    """The knobs one objective is judged under: the engine-global base
+    with the objective's non-None overrides applied."""
+    over = objective.knobs
+    if over is None:
+        return base
+    import dataclasses as _dc
+    fields = {f.name: getattr(over, f.name)
+              for f in over.__dataclass_fields__.values()
+              if getattr(over, f.name) is not None}
+    return _dc.replace(base, **fields) if fields else base
+
+
 # -- the alert state machine -------------------------------------------------
 
 
@@ -367,6 +455,10 @@ class SloEngine:
         self.objectives = list(objectives if objectives is not None
                                else default_slos())
         self.knobs = knobs if knobs is not None else knobs_from_env()
+        # per-objective knobs resolved once: engine-global base + the
+        # objective's non-None overrides (SloObjective.knobs)
+        self._knobs_by: dict[str, SloKnobs] = {
+            o.name: resolve_knobs(self.knobs, o) for o in self.objectives}
         self._clock = clock
         self._wall = wall
         self._lock = threading.Lock()
@@ -383,21 +475,25 @@ class SloEngine:
 
     # -- the clock-driven half --------------------------------------------
 
-    def _burn(self, name: str, now: float, window: float,
-              budget: float) -> float | None:
+    def _burn(self, name: str, now: float, window: float, budget: float,
+              min_samples: int | None = None) -> float | None:
         """Burn rate over the trailing window (run-length-scaled for
         free: verdicts only exist after start), or None below
         ``min_samples``."""
+        if min_samples is None:
+            min_samples = self._knobs_by[name].min_samples
         cut = now - window
         sel = [bad for (t, bad) in self._verdicts[name] if t >= cut]
-        if len(sel) < self.knobs.min_samples:
+        if len(sel) < min_samples:
             return None
         return (sum(sel) / len(sel)) / max(budget, 1e-9)
 
     def _firing(self, o: SloObjective, now: float) -> tuple[bool, bool]:
-        k = self.knobs
-        fast = [self._burn(o.name, now, w, o.budget) for w in k.fast]
-        slow = [self._burn(o.name, now, w, o.budget) for w in k.slow]
+        k = self._knobs_by[o.name]      # per-objective windows/damping
+        fast = [self._burn(o.name, now, w, o.budget, k.min_samples)
+                for w in k.fast]
+        slow = [self._burn(o.name, now, w, o.budget, k.min_samples)
+                for w in k.slow]
         page = all(b is not None and b >= k.page_burn for b in fast)
         warn = all(b is not None and b >= k.warn_burn for b in slow)
         return page, warn
@@ -420,7 +516,8 @@ class SloEngine:
                     self._good[o.name] += int(verdict)
                     self._total[o.name] += 1
                 page, warn = self._firing(o, now)
-                tr = self._alerts[o.name].step(page, warn, now, self.knobs)
+                tr = self._alerts[o.name].step(page, warn, now,
+                                               self._knobs_by[o.name])
                 if tr is not None:
                     event = {"t_s": round(now - self._t0, 3),
                              "wall": round(self._wall(), 3),
@@ -453,14 +550,15 @@ class SloEngine:
         """True when no enabled objective has burned ANY budget over the
         slow-long window (and none is alerting) — the scale-down hint:
         capacity is comfortably above objective."""
-        cut = now - self.knobs.slow[-1]
         judged = 0
         for o in self.objectives:
+            k = self._knobs_by[o.name]
+            cut = now - k.slow[-1]
             a = self._alerts[o.name]
             if a.state != OK or a.warn:
                 return False
             sel = [bad for (t, bad) in self._verdicts[o.name] if t >= cut]
-            if len(sel) >= self.knobs.min_samples:
+            if len(sel) >= k.min_samples:
                 judged += 1
                 if any(sel):
                     return False
@@ -481,8 +579,9 @@ class SloEngine:
             objectives = []
             for o in self.objectives:
                 a = self._alerts[o.name]
-                bf = self._burn(o.name, now, self.knobs.fast[-1], o.budget)
-                bs = self._burn(o.name, now, self.knobs.slow[-1], o.budget)
+                k = self._knobs_by[o.name]
+                bf = self._burn(o.name, now, k.fast[-1], o.budget)
+                bs = self._burn(o.name, now, k.slow[-1], o.budget)
                 total = self._total[o.name]
                 objectives.append({
                     "name": o.name, "signal": o.signal, "op": o.op,

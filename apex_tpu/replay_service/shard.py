@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.obs import spans as obs_spans
+from apex_tpu.serving import fence
 
 #: most source-chunk lineage spans carried onto one sampled batch (the
 #: batch mixes many chunks; the freshest few keep frame-age measurable)
@@ -223,7 +224,7 @@ class ReplayShardCore:
         write-backs will never arrive, so they are forgiven immediately
         (counted) instead of wedging the strict gate until the silence
         timeout.  Returns the number forgiven."""
-        if epoch <= self.learner_epoch:
+        if not fence.newer_epoch(epoch, self.learner_epoch):
             return 0
         forgiven = 0
         if self.learner_epoch and self.outstanding() > 0:
@@ -240,10 +241,11 @@ class ReplayShardCore:
         stamped with a STALE learner epoch (a restarted learner's
         predecessor) is rejected and counted — applying it would corrupt
         priorities on rows the new learner's stream now owns."""
-        if epoch and self.learner_epoch and epoch < self.learner_epoch:
+        if epoch and self.learner_epoch \
+                and fence.stale_epoch(epoch, self.learner_epoch):
             self.stale_wb += 1
             return False
-        if epoch > self.learner_epoch:
+        if fence.newer_epoch(epoch, self.learner_epoch):
             self.learner_epoch = epoch
         if seq < self.wb_applied:
             self.dup_wb += 1

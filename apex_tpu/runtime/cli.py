@@ -46,13 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "replay",
-                            "infer", "status", "loadgen", "dqn", "aql",
-                            "r2d2", "apex", "enjoy"],
+                            "infer", "serve-ctl", "status", "loadgen",
+                            "dqn", "aql", "r2d2", "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator/replay "
                         "(one prioritized-replay shard — see "
-                        "--replay-shards/--shard-id)/infer (the "
-                        "centralized batched-inference server for "
-                        "--remote-policy actors); "
+                        "--replay-shards/--shard-id)/infer (one "
+                        "batched-inference shard for --remote-policy "
+                        "actors — see --infer-shards/--infer-shard-id)/"
+                        "serve-ctl (the serving tier's canary "
+                        "deployment controller, apex_tpu/serving); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
                         "loadgen: standalone on-device rollout fleet "
@@ -189,6 +191,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "(device_put per publish — the d2d path on a "
                         "shared-device deployment); skipped on the CPU "
                         "backend")
+    # sharded serving tier (apex_tpu/serving): shard count rides COMMON
+    # (clients hash to shards, so the whole fleet must agree); the
+    # serve-ctl knobs are controller-local
+    p.add_argument("--infer-shards", type=int,
+                   default=int(e.get("APEX_INFER_SHARDS",
+                                     c.infer_shards)),
+                   help="N infer servers, shard s binding infer_port+s; "
+                        "remote-policy workers route by a stable "
+                        "identity hash (1 = the single PR 9 server)")
+    p.add_argument("--infer-shard-id", type=int,
+                   default=int(e.get("INFER_SHARD_ID", 0)),
+                   help="infer role: this process's shard index in "
+                        "[0, infer_shards)")
+    p.add_argument("--serve-canary-frac", type=float,
+                   default=float(e.get("APEX_SERVE_CANARY_FRAC") or 0.5),
+                   help="serve-ctl: fraction of shards canarying a new "
+                        "model version (lowest indices; the rest pin "
+                        "the incumbent)")
+    p.add_argument("--serve-soak", type=float,
+                   default=float(e.get("APEX_SERVE_SOAK_S") or 60.0),
+                   help="serve-ctl: seconds the canary's eval-score and "
+                        "round-trip SLOs must hold before fleet-wide "
+                        "promotion")
+    p.add_argument("--serve-version-every", type=int,
+                   default=int(e.get("APEX_SERVE_VERSION_EVERY") or 0),
+                   help="serve-ctl: minimum param-version spacing "
+                        "between deployments within one learner epoch "
+                        "(0 = deploy on epoch changes only)")
+    p.add_argument("--serve-interval", type=float,
+                   default=float(e.get("APEX_SERVE_INTERVAL_S") or 5.0),
+                   help="serve-ctl: seconds between control rounds "
+                        "(learner probe + shard reconcile)")
     # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
     # and the registry/park state-machine windows — env twins so a whole
     # topology (tests, chaos drills) retunes them without flag plumbing
@@ -328,7 +362,8 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                           infer_window_ms=args.infer_window_ms,
                           infer_wait_s=args.infer_wait,
                           infer_reprobe_s=args.infer_reprobe,
-                          infer_device_params=args.infer_device_params),
+                          infer_device_params=args.infer_device_params,
+                          infer_shards=args.infer_shards),
     )
 
 
@@ -414,16 +449,33 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                          max_seconds=args.max_seconds,
                          snapshot_dir=args.replay_snapshot_dir)
     elif args.role == "infer":
-        # the centralized batched-inference server (apex_tpu/
-        # infer_service): binds infer_port, subscribes the learner's
-        # param channel, serves --remote-policy actors until killed /
-        # --max-seconds.  Skips the startup barrier like replay shards —
-        # actors act locally until it answers, so launch order is free.
+        # one batched-inference shard (apex_tpu/infer_service +
+        # apex_tpu/serving): binds infer_port + shard id, subscribes the
+        # learner's param channel, serves its hashed worker band until
+        # killed / --max-seconds.  Skips the startup barrier like replay
+        # shards — actors act locally until it answers, so launch order
+        # is free.
         from apex_tpu.infer_service.service import run_infer_server
         from apex_tpu.runtime.roles import _with_ips
         cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
         run_infer_server(cfg, family=args.family,
+                         server_id=args.infer_shard_id,
                          max_seconds=args.max_seconds)
+    elif args.role == "serve-ctl":
+        # the serving tier's deployment controller (apex_tpu/serving/
+        # deploy): canaries new model versions onto a shard fraction,
+        # promotes on healthy SLO soak, rolls back by epoch on breach.
+        # Skips the barrier — it holds until the learner's status port
+        # answers.
+        from apex_tpu.runtime.roles import _with_ips
+        from apex_tpu.serving.deploy import run_serve_ctl
+        cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
+        run_serve_ctl(cfg, identity,
+                      canary_frac=args.serve_canary_frac,
+                      soak_s=args.serve_soak,
+                      version_every=args.serve_version_every,
+                      interval_s=args.serve_interval,
+                      max_seconds=args.max_seconds)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the

@@ -480,6 +480,7 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
     import jax.numpy as jnp
 
     from apex_tpu.actors.pool import EpisodeStat
+    from apex_tpu.fleet.chaos import chaos_from_env
     from apex_tpu.fleet.heartbeat import HeartbeatEmitter
     from apex_tpu.obs.trace import get_ring, set_process_label
 
@@ -566,6 +567,14 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             "acks_received": getattr(sender, "acks_received", 0)}),
         park_fn=park.park_state if park is not None else None,
         gauges_fn=_eval_gauges)
+    # chaos score_bias (serving-tier canary drills): a scheduled
+    # model-quality regression — after after_s of this run, every
+    # reported score shifts by delta, so the eval-ladder gauges and the
+    # eval_score SLO see a degraded model on a deterministic schedule
+    chaos = chaos_from_env()
+    plan = (chaos.plan_for(emitter.identity) if chaos is not None
+            else None)
+    bias_t0 = time.monotonic()
     key = jax.random.key(cfg.env.seed + 31337)
     ep = 0
     while not stop_event.is_set() and (episodes <= 0 or ep < episodes):
@@ -584,6 +593,10 @@ def _evaluator_body(cfg, identity, family, stop_event, episodes, max_steps,
             hb = emitter.maybe_beat(version)
             if hb is not None:
                 sender.send_stat(hb)
+        if (plan is not None and plan.score_bias_after_s is not None
+                and time.monotonic() - bias_t0
+                >= plan.score_bias_after_s):
+            total += plan.score_bias_delta
         scores.append(total)
         recent_scores.append(total)
         ring.complete("episode", ep_t0, time.perf_counter() - ep_t0,

@@ -1,0 +1,33 @@
+"""Sharded serving tier with epoch-fenced canary deployments.
+
+PR 9's inference plane is one process serving one fleet; this package
+turns it into a version-controlled serving TIER — the Ape-X
+separation-of-concerns argument (arxiv 1803.00933) applied to the
+inference side, with arxiv 2111.01264's useful-work-per-box economics
+deciding how shards pack onto hosts:
+
+* :mod:`~apex_tpu.serving.shard` — the shard fabric: N infer servers on
+  ``infer_port + s``, workers routed by a stable identity hash, each
+  shard inheriting PR 9's down-marker/local-fallback/re-probe semantics
+  (a dead shard degrades its worker band to bit-identical local acting,
+  never to a stall).
+* :mod:`~apex_tpu.serving.fence` — the model-version order:
+  ``(learner_epoch, param_version)`` lexicographic, the ONE place
+  epoch/version comparisons live (apexlint J016 keeps it that way).
+* :mod:`~apex_tpu.serving.deploy` — the deployment controller
+  (``--role serve-ctl``): new model versions canary onto a shard
+  fraction behind the servers' epoch-fenced param gate, promote when
+  the eval-ladder score and round-trip SLO hold for a soak window
+  (:class:`~apex_tpu.obs.slo.SloEngine` verdicts — PR 11's machinery,
+  not a second judge), and roll back BY EPOCH on breach, with the
+  bounded deployment timeline surfaced in ``fleet_summary.json``, the
+  ``--role status`` table, and ``apex_serving_*`` Prometheus rows.
+"""
+
+from apex_tpu.serving.deploy import (DeployController, ServeCtl,
+                                     ServingStat, run_serve_ctl)
+from apex_tpu.serving.shard import (infer_shard, make_infer_client,
+                                    shard_port)
+
+__all__ = ["DeployController", "ServeCtl", "ServingStat", "infer_shard",
+           "make_infer_client", "run_serve_ctl", "shard_port"]

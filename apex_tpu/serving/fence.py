@@ -1,0 +1,64 @@
+"""Model-version fencing: THE ordering helpers for epochs and versions.
+
+A model VERSION in the serving tier is the pair ``(learner_epoch,
+param_version)``: the epoch is the major key (a restarted learner's
+params are a NEW model no matter what its version counter says — PR 8's
+life fencing), the param version the minor key (within one life the
+publish counter orders models totally).  Every ordering decision the
+serving tier makes — the server-side param gate, the canary
+promotion/rollback fences, the replay shards' stale-write-back
+rejection — routes through the helpers below, so "is this model newer"
+has exactly one spelling in the codebase.
+
+apexlint J016 (``raw-epoch-comparison``) enforces the routing: an
+ordering comparison on a ``learner_epoch``/``param_version`` attribute
+anywhere outside this module is a finding.  The hazard is concrete: a
+scattered ``>=`` on a raw epoch is how a rollback path keeps serving a
+dead life's params, or rejects a legitimately restored incumbent as
+"stale" — the lexicographic pair below is the only comparison that
+survives both restarts and rollbacks.
+
+Pure stdlib, no imports at all: the replay shards, the infer servers,
+and the deployment controller all call in from their hot paths.
+"""
+
+from __future__ import annotations
+
+
+def fence_key(epoch, version) -> tuple[int, int]:
+    """The total order on models: ``(learner_epoch, param_version)``,
+    epoch-major.  ``None``/absent components clamp to 0 (the pre-fencing
+    wire format's unstamped messages sort before everything real)."""
+    return (int(epoch or 0), int(version or 0))
+
+
+def beyond(epoch, version, fence: tuple) -> bool:
+    """True when ``(epoch, version)`` is strictly newer than ``fence`` —
+    the server-side param gate's hold condition and the rollback
+    trigger."""
+    return fence_key(epoch, version) > fence_key(*fence)
+
+
+def at_or_before(epoch, version, fence: tuple) -> bool:
+    """The gate's install condition (complement of :func:`beyond`)."""
+    return not beyond(epoch, version, fence)
+
+
+def newer_epoch(epoch, current) -> bool:
+    """Epoch-only ordering: ``epoch`` proves a LATER learner life than
+    ``current`` (the replay shards' restart detection)."""
+    return int(epoch or 0) > int(current or 0)
+
+
+def stale_epoch(epoch, current) -> bool:
+    """``epoch`` belongs to an EARLIER life than ``current`` — the
+    write-back/reply rejection condition (a dead life's stragglers)."""
+    return int(epoch or 0) < int(current or 0)
+
+
+def fmt(fence) -> str | None:
+    """Human/JSON spelling of a fence: ``"epoch:version"``."""
+    if fence is None:
+        return None
+    e, v = fence_key(*fence)
+    return f"{e}:{v}"

@@ -1,0 +1,56 @@
+"""Shard fabric for the serving tier: identity-hashed infer-shard routing.
+
+PR 9's inference plane is ONE server on ``comms.infer_port``; the
+serving tier runs ``comms.infer_shards`` of them, shard ``s`` binding
+``infer_port + s`` (the replay service's port-base discipline).  Each
+remote-policy worker routes ALL of its half-group requests to one home
+shard by a stable hash of its worker identity — deterministic, uniform,
+and computable anywhere (the tests pin the mapping), so "which shard
+serves actor-3" is a function, not a lookup.
+
+Identity-hash (not per-request) routing is deliberate: a worker's two
+half-groups must land in the SAME server's coalesce window to batch
+together, and the per-worker :class:`~apex_tpu.infer_service.client.
+InferClient` machinery — down-marker, bit-identical local fallback,
+re-probe — then gives every shard PR 9's exact single-server semantics
+for free: a dead shard degrades precisely the worker band hashed to it,
+and degrades it to local acting, never to a stall.
+
+The hash keys on ``identity#n_shards`` so a re-shard remaps the whole
+fleet uniformly instead of stranding the old mapping's tail.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from apex_tpu.config import CommsConfig
+
+
+def infer_shard(identity: str, n_shards: int) -> int:
+    """Stable worker-identity -> home-shard index (crc32, like the chunk
+    plane's :func:`~apex_tpu.replay_service.sender.chunk_shard`):
+    identical across processes, platforms, and runs."""
+    n = max(1, int(n_shards))
+    return zlib.crc32(f"{identity}#{n}".encode()) % n
+
+
+def shard_port(comms: CommsConfig, shard: int) -> int:
+    """Shard ``s`` binds ``infer_port + s`` (shard 0 IS the PR 9 single
+    server — an unsharded config is the 1-shard tier)."""
+    return comms.infer_port + int(shard)
+
+
+def make_infer_client(comms: CommsConfig, identity: str, **kw):
+    """The worker-side constructor for the sharded tier: one
+    :class:`~apex_tpu.infer_service.client.InferClient` pointed at this
+    identity's home shard, with the shard index stamped on the client so
+    its heartbeat gauges attribute fallbacks/stale-epoch discards to the
+    shard that caused them (a mis-pinned shard shows up in
+    ``--role status``, not only in local counters)."""
+    from apex_tpu.infer_service.client import InferClient
+
+    s = infer_shard(identity, getattr(comms, "infer_shards", 1))
+    client = InferClient(comms, identity, port=shard_port(comms, s), **kw)
+    client.shard = s
+    return client

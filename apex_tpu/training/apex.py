@@ -43,6 +43,7 @@ from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
 from apex_tpu.ops.losses import make_optimizer
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.serving.deploy import ServingStat
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
                                           Checkpointer)
 from apex_tpu.training.learner import LearnerCore
@@ -151,6 +152,13 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # snapshot's severity.  Lazily built on the first health tick so
     # knob env twins set by a drill are honored.
     _slo = None
+    # serving tier (apex_tpu/serving): the deployment controller's
+    # latest snapshot, shipped as a ServingStat on the stat channel —
+    # folded into fleet_summary.json ("serving" section), the status
+    # table, the SLO signal space, and the apex_serving_* rows, so the
+    # canary timeline survives the controller the way the registry
+    # survives an actor
+    serving_state: dict | None = None
 
     # -- param plane -------------------------------------------------------
 
@@ -499,6 +507,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     if isinstance(stat, Heartbeat):
                         self.fleet.observe(stat)
                         continue
+                    if isinstance(stat, ServingStat):
+                        self.serving_state = dict(stat.snapshot)
+                        continue
                     if isinstance(stat, ActorTimingStat):
                         self.actor_timing[stat.actor_id] = stat
                         self.log.scalars(
@@ -635,6 +646,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 self._slo.snapshot())
             gauges.update(slo_gauges)
             labeled.update(slo_labeled)
+        if self.serving_state is not None:
+            # apex_serving_* rows: the canary machine + per-shard pin
+            # view, scraped from the same surface as the slo rows
+            from apex_tpu.serving import deploy as serving_deploy
+            srv_gauges, srv_labeled = serving_deploy.prometheus_sections(
+                self.serving_state)
+            gauges.update(srv_gauges)
+            labeled.update(srv_labeled)
         return obs_metrics.render(gauges=gauges, counters=counters,
                                   histograms=histograms, labeled=labeled)
 
@@ -656,6 +675,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                         if self._obs is not None else {}),
             "rates": {"steps_per_s": self.steps_rate.rate,
                       "frames_per_s": self.frames_rate.rate},
+            # serving-tier counters ("serving.rollbacks" objective):
+            # the dotted walk judges the controller's reported machine
+            "serving": self.serving_state or {},
         }
 
     def _slo_tick(self, steps: int) -> None:
@@ -691,6 +713,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
         # backpressure signal scale supervisors key off, re-admissions,
         # and the chaos receiver's withheld-ack count
         m["learner_epoch"] = self.learner_epoch
+        # the published model fence (epoch-major, version-minor —
+        # serving/fence.py): the serving tier's deployment controller
+        # buckets deployable VERSIONS off exactly this pair, so the
+        # status surface is the one place "what model is newest" lives
+        m["param_version"] = self.param_version
         m["floor_relaxed"] = self._floor_relaxed
         m["floor_relaxes"] = self.floor_relaxes
         m["dead_actor_frac"] = round(
@@ -723,6 +750,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
             snap["latency"] = lat
         if self._slo is not None:
             snap["slo"] = self._slo.snapshot()
+        if self.serving_state is not None:
+            # the serving tier's deployment machine (canary state,
+            # per-shard pins, bounded timeline) — the serve-smoke drill
+            # asserts its promotion/rollback edges from this persisted
+            # section after the fleet is gone
+            snap["serving"] = self.serving_state
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {

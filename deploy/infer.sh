@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
-# Infer-host bootstrap (apex_tpu/infer_service — the centralized batched
-# policy server for --remote-policy actors): one supervised process
-# binding infer_port (54001).  The server subscribes the learner's param
-# PUB like any actor (no new publish cycle) and heartbeats into the
-# learner's chunk port, so the fleet registry runs its state machine
-# over it for free; a chaos-killed/crashed server costs the actor fleet
-# one APEX_INFER_WAIT each (local-policy fallback, bit-identical by the
-# parity pin) and the supervised respawn gets its traffic back through
-# the clients' re-probe.
+# Infer-host bootstrap (apex_tpu/infer_service + apex_tpu/serving — the
+# sharded batched policy tier for --remote-policy actors):
+# APEX_INFER_SHARDS supervised processes, shard s binding 54001 + s,
+# each serving its identity-hashed worker band.  Every server
+# subscribes the learner's param PUB like any actor (no new publish
+# cycle) and heartbeats into the learner's chunk port, so the fleet
+# registry runs its state machine over each shard for free; a
+# chaos-killed/crashed shard costs its band one APEX_INFER_WAIT each
+# (local-policy fallback, bit-identical by the parity pin) and the
+# supervised respawn gets its traffic back through the clients'
+# re-probe.  Export APEX_SERVE_CTL=1 to co-locate the canary deployment
+# controller (--role serve-ctl, apex_tpu/serving/deploy) on this host.
 set -euo pipefail
 command -v git >/dev/null || (apt-get update && apt-get install -y git)
 cd /opt
@@ -22,10 +25,22 @@ cd apex-tpu
 # APEX_INFER_DEVICE_PARAMS=1 so subscribed params stay device-resident
 # (the device-to-device copy path); the CPU default serves correctness
 # and small fleets.
-tmux new -s "infer-0" -d \
-  "JAX_PLATFORMS=cpu APEX_ROLE=infer LEARNER_IP=${learner_ip} \
-   APEX_REMOTE_POLICY=1 \
-   /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
-     --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
-     /opt/apex-env/bin/python -m apex_tpu.runtime \
-     --env-id ${env_id}; read"
+INFER_SHARDS="$${APEX_INFER_SHARDS:-1}"
+for s in $(seq 0 $((INFER_SHARDS - 1))); do
+  tmux new -s "infer-$s" -d \
+    "JAX_PLATFORMS=cpu APEX_ROLE=infer LEARNER_IP=${learner_ip} \
+     APEX_REMOTE_POLICY=1 APEX_INFER_SHARDS=$INFER_SHARDS \
+     /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+       --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+       /opt/apex-env/bin/python -m apex_tpu.runtime \
+       --infer-shard-id $s --env-id ${env_id}; read"
+done
+if [ "$${APEX_SERVE_CTL:-0}" = "1" ]; then
+  tmux new -s "serve-ctl" -d \
+    "JAX_PLATFORMS=cpu APEX_ROLE=serve-ctl LEARNER_IP=${learner_ip} \
+     APEX_REMOTE_POLICY=1 APEX_INFER_SHARDS=$INFER_SHARDS \
+     /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
+       --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
+       /opt/apex-env/bin/python -m apex_tpu.runtime \
+       --env-id ${env_id}; read"
+fi

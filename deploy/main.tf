@@ -52,12 +52,16 @@ resource "google_compute_firewall" "apex_infer_port" {
 
   allow {
     protocol = "tcp"
-    # CommsConfig.infer_port: the infer server's request ROUTER —
-    # remote-policy actors connect their per-worker DEALERs here
-    ports = ["54001"]
+    # infer_port .. +15: serving shard s binds 54001 + s (CommsConfig
+    # .infer_port + APEX_INFER_SHARDS, apex_tpu/serving/shard.py; 16
+    # shards per host is the supported ceiling, like replay).
+    # Remote-policy actors connect their per-worker DEALERs to their
+    # identity-hashed home shard; the serve-ctl controller's gate
+    # commands ride the same ROUTERs.
+    ports = ["54001-54016"]
   }
 
-  source_tags = ["apex-actor"]
+  source_tags = ["apex-actor", "apex-serve-ctl"]
   target_tags = ["apex-infer"]
 }
 

@@ -100,21 +100,40 @@ if [ "$REPLAY_SHARDS" -gt 0 ]; then
 fi
 
 if [ "$REMOTE_POLICY" = "1" ]; then
-  # the infer server skips the startup barrier (useful the moment its
-  # ROUTER binds); launch before the actors so their first vector steps
-  # already batch centrally instead of burning one fallback wait each.
-  # APEX_SUPERVISE_INFER=1 wraps it in the host supervisor so a
-  # chaos-killed server respawns in seconds (the kill disarms on the
-  # supervised life) and the SLO engine's round-trip alert can walk the
-  # full BREACHED -> RESOLVED cycle — the slo-smoke drill's topology.
-  if [ "${APEX_SUPERVISE_INFER:-0}" = "1" ]; then
-    python -m apex_tpu.fleet.supervise --min-uptime 1 \
-      --backoff 0.5 --backoff-max 2 -- \
-      python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
-  else
-    python -m apex_tpu.runtime --role infer "${COMMON[@]}" &
+  # Sharded serving tier (apex_tpu/serving): APEX_INFER_SHARDS=N runs N
+  # infer servers, shard s binding infer_port + s; remote-policy workers
+  # hash to a home shard by identity.  The servers skip the startup
+  # barrier (useful the moment their ROUTERs bind); launch before the
+  # actors so their first vector steps already batch centrally instead
+  # of burning one fallback wait each.  APEX_SUPERVISE_INFER=1 wraps
+  # each shard in the host supervisor so a chaos-killed server respawns
+  # in seconds (the kill disarms on the supervised life) and the SLO
+  # engine's round-trip alert can walk the full BREACHED -> RESOLVED
+  # cycle — the slo-smoke drill's topology.
+  INFER_SHARDS="${APEX_INFER_SHARDS:-1}"
+  export APEX_INFER_SHARDS="$INFER_SHARDS"
+  for s in $(seq 0 $((INFER_SHARDS - 1))); do
+    if [ "${APEX_SUPERVISE_INFER:-0}" = "1" ]; then
+      python -m apex_tpu.fleet.supervise --min-uptime 1 \
+        --backoff 0.5 --backoff-max 2 -- \
+        python -m apex_tpu.runtime --role infer --infer-shard-id "$s" \
+        "${COMMON[@]}" &
+    else
+      python -m apex_tpu.runtime --role infer --infer-shard-id "$s" \
+        "${COMMON[@]}" &
+    fi
+    pids+=($!)
+  done
+  # Canary deployment controller (apex_tpu/serving/deploy, --role
+  # serve-ctl): APEX_SERVE_CTL=1 launches it against the shard tier —
+  # new model versions canary onto APEX_SERVE_CANARY_FRAC of the
+  # shards, promote after APEX_SERVE_SOAK_S of healthy SLO, roll back
+  # by epoch on breach; the deployment timeline lands in the learner's
+  # fleet_summary.json and apex_serving_* Prometheus rows.
+  if [ "${APEX_SERVE_CTL:-0}" = "1" ]; then
+    python -m apex_tpu.runtime --role serve-ctl "${COMMON[@]}" &
+    pids+=($!)
   fi
-  pids+=($!)
 fi
 
 # SLO soak traffic (apex_tpu/obs/soak.py): APEX_LOADGEN=N spawns N

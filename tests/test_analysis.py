@@ -1307,6 +1307,58 @@ def test_j015_silent_on_gauge_keys_in_plain_dicts():
         """, "J015")
 
 
+# -- J016: raw epoch/version ordering outside the fencing helpers ------------
+
+def test_j016_fires_on_raw_epoch_ordering():
+    # the replay-shard shape: an attribute epoch ordered against a local
+    assert fires("""
+        class Shard:
+            def write_back(self, epoch):
+                if epoch < self.learner_epoch:
+                    return False
+        """, "J016")
+    # param_version too, and bare names count as well as attributes
+    assert fires("""
+        def gate(incoming, param_version):
+            return incoming.param_version >= param_version
+        """, "J016")
+
+
+def test_j016_silent_on_equality_literals_and_fence_module():
+    # identity checks are not ordering — fencing only cares about </>
+    assert not fires("""
+        class Shard:
+            def seen(self, epoch):
+                return epoch == self.learner_epoch
+        """, "J016")
+    # ordering against a LITERAL (test progress assertions like
+    # `param_version >= 2`) cannot smuggle a dead life's value
+    assert not fires("""
+        def check(trainer):
+            assert trainer.param_version >= 2
+            assert trainer.learner_epoch > 0
+        """, "J016")
+    # THE fencing helper module is the one place raw ordering lives
+    src = textwrap.dedent("""
+        def newer_epoch(epoch, learner_epoch):
+            return epoch > learner_epoch
+        """)
+    findings, _ = analyze_source(
+        src, path="apex_tpu/serving/fence.py",
+        rules={"J016": all_rules()["J016"]})
+    assert not findings
+
+
+def test_j016_fires_on_epoch_vs_version_cross_compare():
+    # the exact wrong-lifetime hazard: ordering a version against an
+    # epoch variable as if they shared a scale
+    assert fires("""
+        def promote(reply, server):
+            if reply.learner_epoch >= server.param_version:
+                return True
+        """, "J016")
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

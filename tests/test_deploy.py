@@ -127,10 +127,11 @@ def test_replay_firewall_range_matches_comms_config():
 
 
 def test_infer_firewall_and_heartbeat_path_match_comms_config():
-    """The infer-host rule must open exactly CommsConfig.infer_port with
-    actors as the source (their per-worker DEALERs connect there) — and
-    the return paths to the learner (param SUB on 52001, heartbeats on
-    the chunk port) must include apex-infer as a source."""
+    """The infer-host rule must open the serving shard range anchored at
+    CommsConfig.infer_port (shard s binds infer_port + s, 16 per host
+    like replay) with actors AND the serve-ctl controller as sources —
+    and the return paths to the learner (param SUB on 52001, heartbeats
+    on the chunk port) must include apex-infer as a source."""
     from apex_tpu.config import CommsConfig
 
     main = (DEPLOY / "main.tf").read_text()
@@ -139,11 +140,14 @@ def test_infer_firewall_and_heartbeat_path_match_comms_config():
         main, re.DOTALL)
     assert m, "no apex_infer_port firewall resource"
     body, targets = m.group(1), m.group(2)
-    ports = {int(p) for p in re.findall(r'"(\d+)"', body)}
-    assert CommsConfig().infer_port in ports
+    r = re.search(r'"(\d+)-(\d+)"', body)
+    assert r, "infer firewall opens no shard port range"
+    lo, hi = int(r.group(1)), int(r.group(2))
+    assert lo == CommsConfig().infer_port
+    assert hi >= CommsConfig().infer_port + 15   # 16 shards per host
     assert "apex-infer" in targets
     src = re.search(r'source_tags\s*=\s*\[([^\]]*)\]', body).group(1)
-    assert "apex-actor" in src
+    assert "apex-actor" in src and "apex-serve-ctl" in src
     learner_rule = re.search(
         r'"apex_ports"(.*?)target_tags\s*=\s*\[([^\]]*)\]',
         main, re.DOTALL).group(1)

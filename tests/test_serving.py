@@ -1,0 +1,576 @@
+"""Sharded serving tier + epoch-fenced canary deployments (apex_tpu/serving).
+
+Four contracts pinned here:
+
+* the shard-routing hash (stable, uniform, computable anywhere);
+* per-shard reply bit-parity vs local acting (each shard inherits PR 9's
+  whole parity/fallback/re-probe story for its hashed worker band);
+* the server-side version gate (pin holds installs, canary stashes the
+  incumbent, rollback restores it BIT-IDENTICALLY, promote clears);
+* the canary state machine under fake clocks and scripted SLO states
+  (CANARY→PROMOTED on healthy soak, CANARY→ROLLED_BACK on breach,
+  rejected versions never re-canaried), plus the deployment-timeline
+  schema the CI serve-smoke drill asserts against.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.actors.pool import actor_epsilons
+from apex_tpu.actors.vector import VectorDQNWorkerFamily
+from apex_tpu.config import CommsConfig, small_test_config
+from apex_tpu.infer_service import InferServer
+from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.runtime import wire
+from apex_tpu.serving import fence
+from apex_tpu.serving.deploy import (CANARY, IDLE, PROMOTED, ROLLED_BACK,
+                                     DeployController, ServingStat,
+                                     format_serving_lines,
+                                     prometheus_sections)
+from apex_tpu.serving.shard import infer_shard, make_infer_client, shard_port
+from apex_tpu.training.apex import dqn_env_specs
+from apex_tpu.training.state import create_train_state
+
+SLO_OK = {"eval_score": "OK", "infer_rt_p99_ms": "OK"}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cfg(n_shards: int = 2, **comms_kw):
+    cfg = small_test_config()
+    return cfg.replace(comms=CommsConfig(infer_port=_free_port(),
+                                         infer_shards=n_shards,
+                                         **comms_kw))
+
+
+def _params(cfg, model_spec, seed: int = 0):
+    _, frame_shape, frame_dtype, frame_stack = dqn_env_specs(cfg)
+    stacked = frame_shape[:-1] + (frame_stack * frame_shape[-1],)
+    model = DuelingDQN(**model_spec)
+    ts = create_train_state(model, make_optimizer(), jax.random.key(seed),
+                            np.zeros((1,) + stacked, frame_dtype))
+    return model, ts.params
+
+
+def _serve(cfg, model, params, shard: int = 0, version=3, epoch=1):
+    """A live shard server on its shard port, on a background thread."""
+    server = InferServer(cfg.comms, make_policy_fn(model),
+                         server_id=shard, heartbeat=False,
+                         port=shard_port(cfg.comms, shard))
+    if params is not None:
+        server.set_params(version, params, epoch=epoch)
+    stop = threading.Event()
+    t = threading.Thread(target=server.run, kwargs={"stop_event": stop},
+                         daemon=True)
+    t.start()
+    return server, stop, t
+
+
+def _family(cfg, model_spec, n_envs):
+    return VectorDQNWorkerFamily(
+        cfg, model_spec, seeds=[100 + i for i in range(n_envs)],
+        slot_ids=list(range(n_envs)), epsilons=actor_epsilons(n_envs),
+        chunk_transitions=16)
+
+
+def _drive(fam, params, n_steps, seed=1):
+    fam.reset_all()
+    key = jax.random.key(seed)
+    stats, msgs = [], []
+    for _ in range(n_steps):
+        key, k = jax.random.split(key)
+        stats.extend(fam.step_all(params, k))
+        msgs.extend(fam.poll_msgs())
+    msgs.extend(m for b in fam.builders
+                for m in ({"payload": c, "priorities": c.pop("priorities"),
+                           "n_trans": int(c["n_trans"])}
+                          for c in b.force_flush()))
+    fam.close()
+    return stats, msgs
+
+
+def _tree_equal(a, b):
+    la = jax.tree.leaves(a)
+    lb = jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- shard routing -----------------------------------------------------------
+
+def test_infer_shard_routing_pins():
+    """The identity hash is a PINNED function: routing recomputes
+    identically anywhere (actor, controller, test, ops shell)."""
+    assert [infer_shard(f"actor-{i}", 2) for i in range(4)] == [1, 0, 1, 0]
+    assert [infer_shard(f"actor-{i}", 3) for i in range(4)] == [1, 1, 2, 0]
+    # degenerate/fleet-wide invariants
+    assert infer_shard("actor-0", 1) == 0
+    assert all(0 <= infer_shard(f"actor-{i}", 5) < 5 for i in range(64))
+    # the shard count is IN the key: a re-shard remaps uniformly instead
+    # of fixing the low shards' population
+    assert {infer_shard(f"actor-{i}", 4) for i in range(64)} == {0, 1, 2, 3}
+
+
+def test_make_infer_client_targets_home_shard():
+    cfg = _cfg(n_shards=2)
+    client = make_infer_client(cfg.comms, "actor-0", wait_s=0.1,
+                               reprobe_s=0.0)
+    try:
+        assert client.shard == infer_shard("actor-0", 2) == 1
+        g = client.gauges()
+        assert g["infer_shard"] == 1
+        assert "infer_epoch_seen" in g and "infer_stale_epoch" in g
+    finally:
+        client.close()
+
+
+# -- the server-side version gate --------------------------------------------
+
+def test_gate_pin_canary_rollback_promote():
+    """The whole gate lifecycle, host-side: canary stashes the incumbent
+    once, newer installs track the stream, rollback restores the stash
+    bit-identically and pins, pinned installs are held (counted), and
+    promote clears everything."""
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p1 = _params(cfg, model_spec, seed=0)
+    _, p2 = _params(cfg, model_spec, seed=7)
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    try:
+        server.set_params(5, p1, epoch=1)
+        st = server.apply_ctl({"cmd": "canary", "rid": 1})
+        assert st["has_incumbent"] and not st["pinned"] and st["rid"] == 1
+        # canary is idempotent: a second one must NOT re-stash (it would
+        # replace the incumbent with the candidate)
+        server.set_params(9, p2, epoch=1)
+        server.apply_ctl({"cmd": "canary"})
+        assert server.param_version == 9
+
+        st = server.apply_ctl({"cmd": "rollback", "epoch": 1, "version": 5})
+        assert st["pinned"] and st["version"] == 5 and st["epoch"] == 1
+        assert server.gate_rollbacks == 1
+        _tree_equal(server.params, p1)      # bit-identical restore
+
+        server.set_params(12, p2, epoch=1)  # beyond the pin: held
+        assert server.held == 1 and server.param_version == 5
+        # at-or-before the pin still installs (a replayed old publish)
+        server.set_params(4, p1, epoch=1)
+        assert server.param_version == 4
+
+        server.apply_ctl({"cmd": "promote"})
+        server.set_params(12, p2, epoch=1)
+        assert server.param_version == 12 and server.held == 1
+    finally:
+        server.close()
+
+
+def test_gate_orders_epoch_major():
+    """The fence is (epoch, version) lexicographic: a pinned shard holds
+    a HIGHER epoch even at a lower version, and admits a lower epoch at
+    any version — PR 8's life fencing as the major key."""
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p1 = _params(cfg, model_spec)
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    try:
+        server.set_params(50, p1, epoch=1)
+        server.apply_ctl({"cmd": "pin", "epoch": 1, "version": 50})
+        server.set_params(2, p1, epoch=2)       # new life, tiny version
+        assert server.held == 1 and server.learner_epoch == 1
+        server.set_params(49, p1, epoch=1)      # same life, older: fine
+        assert server.param_version == 49
+        assert fence.beyond(2, 2, (1, 50))      # the helper agrees
+    finally:
+        server.close()
+
+
+def test_rollback_without_incumbent_serves_dry():
+    """A respawned canary shard that picked the candidate off the stream
+    with no stash must NOT keep serving the rejected model: rollback
+    drops it to dry replies (clients act locally, bit-identically) until
+    promotion unpins."""
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p2 = _params(cfg, model_spec, seed=7)
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    try:
+        server.set_params(9, p2, epoch=1)       # candidate, no stash
+        server.apply_ctl({"cmd": "rollback", "epoch": 1, "version": 5})
+        assert server.params is None            # dry until promotion
+        assert server.ctl_state()["pinned"]
+    finally:
+        server.close()
+
+
+def test_gate_freeze_and_idempotent_rollback():
+    """The steady-state verb: freeze stashes + pins at the shard's OWN
+    fence, so a non-canary shard that had drifted with the stream still
+    has a judged model to restore — and the per-tick rollback reconcile
+    is a no-op on an already-rolled-back shard (it must never push a
+    healthy frozen shard to dry replies)."""
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p2 = _params(cfg, model_spec, seed=7)
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    try:
+        server.set_params(9, p2, epoch=1)
+        st = server.apply_ctl({"cmd": "freeze"})
+        assert st["pinned"] and st["pin"] == [1, 9] and st["has_incumbent"]
+        server.set_params(12, p2, epoch=1)      # frozen: held
+        assert server.held == 1 and server.param_version == 9
+        # rollback against an OLDER controller fence restores the
+        # shard's own stash (a no-op here) and pins at the stash fence —
+        # never dry, never the controller's stale number
+        for _ in range(3):                      # reconcile is idempotent
+            st = server.apply_ctl({"cmd": "rollback", "epoch": 1,
+                                   "version": 5})
+        assert st["has_params"] and st["version"] == 9
+        assert st["pin"] == [1, 9]
+        assert server.gate_rollbacks == 0       # nothing actually moved
+    finally:
+        server.close()
+
+
+def test_ctl_round_trip_over_socket():
+    """The ctl channel multiplexes on the serving ROUTER: a DEALER
+    command gets a ("ctl_ok", state) reply with the rid echoed."""
+    import zmq
+
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p1 = _params(cfg, model_spec)
+    server, stop, t = _serve(cfg, model, p1, shard=0, version=5, epoch=1)
+    sock = zmq.Context.instance().socket(zmq.DEALER)
+    sock.setsockopt(zmq.IDENTITY, b"serve-ctl-0")
+    sock.connect(f"tcp://127.0.0.1:{shard_port(cfg.comms, 0)}")
+    try:
+        sock.send(wire.dumps(("ctl", {"cmd": "pin", "epoch": 1,
+                                      "version": 5, "rid": 42})))
+        assert sock.poll(10_000, zmq.POLLIN), "no ctl reply"
+        kind, body = wire.restricted_loads(sock.recv())
+        assert kind == "ctl_ok"
+        assert body["rid"] == 42 and body["pinned"] and body["shard"] == 0
+        assert body["pin"] == [1, 5]
+    finally:
+        sock.close(linger=0)
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+
+
+# -- per-shard bit-parity ----------------------------------------------------
+
+def test_sharded_replies_bit_identical_to_local():
+    """Two shards, two workers hashed to DIFFERENT shards (the pinned
+    mapping: actor-0 -> 1, actor-1 -> 0 at n=2): each worker's remote
+    trajectories equal its pure-local twin bit for bit, every step
+    actually remote, and both shards served traffic."""
+    cfg = _cfg(n_shards=2)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    s0, stop0, t0 = _serve(cfg, model, params, shard=0)
+    s1, stop1, t1 = _serve(cfg, model, params, shard=1)
+    clients = []
+    try:
+        for ident in ("actor-0", "actor-1"):
+            local = _family(cfg, model_spec, 2)
+            stats_l, msgs_l = _drive(local, params, 60)
+
+            remote = _family(cfg, model_spec, 2)
+            remote.attach_infer(make_infer_client(cfg.comms, ident,
+                                                  wait_s=30.0))
+            clients.append(remote.infer)
+            stats_r, msgs_r = _drive(remote, params, 60)
+
+            assert remote.infer.remote_steps > 0
+            assert remote.infer.fallbacks == 0
+            assert [(s.actor_id, s.reward, s.length) for s in stats_l] \
+                == [(s.actor_id, s.reward, s.length) for s in stats_r]
+            assert len(msgs_l) == len(msgs_r)
+            for ma, mb in zip(msgs_l, msgs_r):
+                np.testing.assert_array_equal(ma["priorities"],
+                                              mb["priorities"])
+    finally:
+        stop0.set()
+        stop1.set()
+        t0.join(timeout=10)
+        t1.join(timeout=10)
+        s0.close()
+        s1.close()
+    assert {c.shard for c in clients} == {0, 1}
+    assert s0.requests > 0 and s1.requests > 0, \
+        "both shards must have served their hashed band"
+
+
+def test_dead_shard_degrades_to_local_and_reprobes_back():
+    """A dead home shard costs its worker band the single-server
+    semantics exactly: local fallback after the wait, down-marker, and a
+    re-probe that regains the (re)spawned shard with no worker restart —
+    while the OTHER shard's existence changes nothing for this band."""
+    cfg = _cfg(n_shards=2)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, params = _params(cfg, model_spec)
+    # only shard 0 is up; actor-0's home shard (1) is dark
+    s0, stop0, t0 = _serve(cfg, model, params, shard=0)
+    fam = _family(cfg, model_spec, 2)
+    fam.attach_infer(make_infer_client(cfg.comms, "actor-0", wait_s=0.3,
+                                       reprobe_s=0.3))
+    client = fam.infer
+    fam.reset_all()
+    key = jax.random.key(1)
+    s1 = stop1 = t1 = None
+    try:
+        for _ in range(3):
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+        assert client.fallbacks > 0 and client.remote_steps == 0
+
+        s1, stop1, t1 = _serve(cfg, model, params, shard=1)
+        deadline = time.monotonic() + 30.0
+        while client.remote_steps == 0 and time.monotonic() < deadline:
+            key, k = jax.random.split(key)
+            fam.step_all(params, k)
+            time.sleep(0.05)
+        assert client.remote_steps > 0, "re-probe never regained shard 1"
+        assert client.reprobes > 0
+        assert s0.requests == 0, "wrong shard took actor-0's traffic"
+    finally:
+        fam.close()
+        stop0.set()
+        t0.join(timeout=10)
+        s0.close()
+        if s1 is not None:
+            stop1.set()
+            t1.join(timeout=10)
+            s1.close()
+
+
+# -- the canary state machine ------------------------------------------------
+
+def _ctrl(n_shards=2, soak_s=10.0, version_every=50, **kw):
+    t = {"now": 0.0}
+    c = DeployController(n_shards, canary_frac=0.5, soak_s=soak_s,
+                         version_every=version_every,
+                         clock=lambda: t["now"],
+                         wall=lambda: 1_000_000.0 + t["now"], **kw)
+    return c, t
+
+
+def test_canary_to_promoted_on_healthy_soak():
+    c, t = _ctrl()
+    cmds = c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    assert c.incumbent == (1, 10) and c.state == IDLE
+    assert all(cmd["cmd"] == "promote" for _, cmd in cmds)
+
+    t["now"] = 5.0          # spacing not met: no deployment
+    c.tick({"epoch": 1, "version": 30}, SLO_OK)
+    assert c.state == IDLE and c.deployments == 0
+
+    t["now"] = 10.0         # version 60 >= 10 + 50: canary
+    cmds = dict(c.tick({"epoch": 1, "version": 60}, SLO_OK))
+    assert c.state == CANARY and c.deployments == 1
+    assert c.canary_shards == (0,)
+    assert cmds[0]["cmd"] == "canary"
+    # the non-canary shard FREEZES at its own judged fence (the
+    # latest-wins stream would otherwise have drifted it past the
+    # incumbent, leaving a rollback nothing judged to restore)
+    assert cmds[1] == {"cmd": "freeze"}
+
+    t["now"] = 22.0         # 12s of healthy soak (>= 10): promote,
+    cmds = dict(c.tick({"epoch": 1, "version": 70}, SLO_OK))
+    assert c.state == PROMOTED and c.promotions == 1
+    assert c.incumbent == (1, 70)   # the canary tracked the live stream
+    # the gate opens so every shard takes the judged version...
+    assert all(cmd["cmd"] == "promote" for cmd in cmds.values())
+    # ...then the tier re-freezes once gate_open_s (default 10) passes
+    t["now"] = 40.0
+    cmds = dict(c.tick({"epoch": 1, "version": 75}, SLO_OK))
+    assert c.state == PROMOTED
+    assert all(cmd["cmd"] == "freeze" for cmd in cmds.values())
+
+
+def test_canary_to_rolled_back_on_breach_and_no_recanary():
+    c, t = _ctrl()
+    c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    t["now"] = 5.0
+    c.tick({"epoch": 1, "version": 60}, SLO_OK)
+    assert c.state == CANARY
+
+    t["now"] = 7.0
+    cmds = dict(c.tick({"epoch": 1, "version": 65},
+                       {"eval_score": "BREACHED",
+                        "infer_rt_p99_ms": "OK"}))
+    assert c.state == ROLLED_BACK and c.rollbacks == 1
+    assert c.incumbent == (1, 10)       # incumbent NEVER moved
+    assert c.rejected == (1, 65)
+    # the rollback edge reaches every shard, by epoch AND version
+    assert all(cmd == {"cmd": "rollback", "epoch": 1, "version": 10}
+               for cmd in cmds.values())
+
+    # the rejected fence is never re-canaried; spacing restarts from it
+    t["now"] = 12.0
+    c.tick({"epoch": 1, "version": 80}, SLO_OK)
+    assert c.state == ROLLED_BACK and c.deployments == 1
+    t["now"] = 17.0
+    c.tick({"epoch": 1, "version": 120}, SLO_OK)
+    assert c.state == CANARY and c.deployments == 2
+
+
+def test_epoch_bump_always_deploys_and_unknown_slo_holds():
+    c, t = _ctrl(version_every=1000)     # spacing alone would never fire
+    c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    t["now"] = 5.0
+    c.tick({"epoch": 2, "version": 2}, SLO_OK)   # restarted learner
+    assert c.state == CANARY, "a new learner epoch IS a new model"
+    # unreadable SLO: soak credit resets — no promotion however long
+    t["now"] = 50.0
+    c.tick({"epoch": 2, "version": 3}, None)
+    t["now"] = 55.0
+    c.tick({"epoch": 2, "version": 3}, SLO_OK)   # credit restarts here
+    t["now"] = 60.0
+    c.tick({"epoch": 2, "version": 3}, SLO_OK)
+    assert c.state == CANARY, "held ticks must not count toward soak"
+    t["now"] = 66.0
+    c.tick({"epoch": 2, "version": 3}, SLO_OK)
+    assert c.state == PROMOTED and c.incumbent == (2, 3)
+
+
+def test_deployment_timeline_schema_pin():
+    """The timeline is the drill's evidence format — its schema is a
+    contract (CI serve-smoke greps these exact keys/edges)."""
+    c, t = _ctrl()
+    c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    t["now"] = 5.0
+    c.tick({"epoch": 1, "version": 60}, SLO_OK)
+    t["now"] = 20.0
+    c.tick({"epoch": 1, "version": 60}, SLO_OK)
+    snap = c.snapshot()
+    assert snap["kind"] == "apex_serving" and snap["version"] == 1
+    assert set(snap) >= {"state", "n_shards", "canary_shards",
+                         "incumbent", "candidate", "rejected",
+                         "deployments", "promotions", "rollbacks",
+                         "shards", "timeline"}
+    assert snap["incumbent"] == {"epoch": 1, "version": 60, "id": "1:60"}
+    edges = [(e["from"], e["to"]) for e in snap["timeline"]]
+    assert (IDLE, CANARY) in edges and (CANARY, PROMOTED) in edges
+    for e in snap["timeline"]:
+        assert set(e) == {"t_s", "wall", "version", "from", "to",
+                          "reason"}
+
+
+def test_single_shard_tier_canaries_whole_tier():
+    c, _ = _ctrl(n_shards=1)
+    assert c.canary_shards == (0,)
+    c2, _ = _ctrl(n_shards=4)
+    # frac 0.5 of 4 = 2 canary shards, 2 pinned incumbents
+    assert c2.canary_shards == (0, 1)
+
+
+# -- evidence surfaces -------------------------------------------------------
+
+def test_serving_stat_survives_the_restricted_wire():
+    c, t = _ctrl()
+    c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    stat = ServingStat("serve-ctl", c.snapshot())
+    got = wire.restricted_loads(wire.dumps(stat))
+    assert got.identity == "serve-ctl"
+    assert got.snapshot["incumbent"]["id"] == "1:10"
+
+
+def test_serving_section_on_status_table_and_prometheus():
+    from apex_tpu.fleet.registry import format_fleet_table
+    from apex_tpu.obs import metrics as obs_metrics
+
+    c, t = _ctrl()
+    c.tick({"epoch": 1, "version": 10}, SLO_OK)
+    t["now"] = 5.0
+    c.tick({"epoch": 1, "version": 60}, SLO_OK)
+    c.shard_view[0] = {"shard": 0, "pinned": False, "epoch": 1,
+                       "version": 60, "held": 0, "rollbacks": 0}
+    c.shard_view[1] = {"shard": 1, "pinned": True, "epoch": 1,
+                       "version": 10, "held": 3, "rollbacks": 0}
+    serving = c.snapshot()
+
+    table = format_fleet_table({"peers": [], "metrics": {},
+                                "serving": serving})
+    assert "serving: CANARY" in table
+    assert "serving shard 1: PINNED model=1:10 held=3" in table
+
+    gauges, labeled = prometheus_sections(serving)
+    # every family is registered (J015's contract — an unregistered row
+    # would be unscrapeable)
+    for name in list(gauges) + list(labeled):
+        assert name in obs_metrics.REGISTERED_FAMILIES, name
+    text = obs_metrics.render(gauges=gauges, labeled=labeled)
+    assert 'apex_serving_state{state="CANARY"} 1.0' in text
+    assert 'apex_serving_shard_pinned{shard="1"} 1.0' in text
+
+
+def test_serve_gauges_are_registered():
+    """Every literal key the shard servers and the controller put into
+    heartbeat gauges is in the declared registry (J015 backs this up
+    statically; the runtime pin keeps the two from drifting)."""
+    from apex_tpu.obs import metrics as obs_metrics
+
+    cfg = _cfg(n_shards=1)
+    model_spec, *_ = dqn_env_specs(cfg)
+    model, p1 = _params(cfg, model_spec)
+    server = InferServer(cfg.comms, make_policy_fn(model), heartbeat=False)
+    try:
+        for key in server.gauges():
+            assert key in obs_metrics.REGISTERED_GAUGES, key
+    finally:
+        server.close()
+    client = make_infer_client(cfg.comms, "actor-0")
+    try:
+        for key in client.gauges():
+            assert key in obs_metrics.REGISTERED_GAUGES, key
+    finally:
+        client.close()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_serving_flags_and_env_twins(monkeypatch):
+    from apex_tpu.runtime.cli import build_parser, config_from_args
+
+    monkeypatch.setenv("APEX_INFER_SHARDS", "3")
+    monkeypatch.setenv("INFER_SHARD_ID", "2")
+    monkeypatch.setenv("APEX_SERVE_CANARY_FRAC", "0.25")
+    monkeypatch.setenv("APEX_SERVE_SOAK_S", "12.5")
+    monkeypatch.setenv("APEX_SERVE_VERSION_EVERY", "40")
+    monkeypatch.setenv("APEX_SERVE_INTERVAL_S", "1.5")
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.comms.infer_shards == 3
+    assert args.infer_shard_id == 2
+    assert args.serve_canary_frac == 0.25
+    assert args.serve_soak == 12.5
+    assert args.serve_version_every == 40
+    assert args.serve_interval == 1.5
+    # the serve-ctl role parses
+    args2 = build_parser().parse_args(["--role", "serve-ctl"])
+    assert args2.role == "serve-ctl"
+
+
+def test_infer_role_rejects_out_of_range_shard():
+    from apex_tpu.infer_service.service import run_infer_server
+
+    cfg = _cfg(n_shards=2)
+    with pytest.raises(ValueError, match="outside"):
+        run_infer_server(cfg, server_id=5)

@@ -201,6 +201,73 @@ def test_default_slos_env_twins_and_threshold_sharing():
     assert k.slow == SloKnobs.slow                    # untouched default
 
 
+# -- per-objective burn/damping overrides (PR 11 carried follow-up) ----------
+
+def test_objective_knob_env_twins_parse_and_merge():
+    from apex_tpu.obs.slo import (SloKnobOverrides, objective_knobs_from_env,
+                                  resolve_knobs)
+
+    # unset twins: no override record at all (engine-global knobs rule)
+    assert objective_knobs_from_env("eval_score", {}) is None
+    over = objective_knobs_from_env(
+        "eval_score", {"APEX_SLO_EVAL_SCORE_FAST": "6,12",
+                       "APEX_SLO_EVAL_SCORE_BREACH_AFTER": "2",
+                       "APEX_SLO_EVAL_SCORE_MIN_SAMPLES": "1"})
+    assert over.fast == (6.0, 12.0) and over.breach_after_s == 2.0
+    assert over.min_samples == 1 and over.slow is None
+    # merge: non-None fields win, everything else inherits the base
+    base = SloKnobs(fast=(60.0, 300.0), breach_after_s=10.0)
+    merged = resolve_knobs(base, SloObjective("eval_score", "x", 1.0,
+                                              knobs=over))
+    assert merged.fast == (6.0, 12.0) and merged.breach_after_s == 2.0
+    assert merged.slow == base.slow and merged.ok_after_s == base.ok_after_s
+    # default_slos wires the twins per objective
+    by = {o.name: o for o in default_slos(
+        environ={"APEX_SLO_EVAL_SCORE_FAST": "6,12"})}
+    assert by["eval_score"].knobs.fast == (6.0, 12.0)
+    assert by["infer_rt_p99_ms"].knobs is None
+
+
+def test_per_objective_windows_tighten_one_objective_only():
+    """The canary-gate shape: eval_score runs tighter fast windows +
+    damping than the engine default, so it BREACHES while a sibling
+    objective judging the SAME bad signal is still only BURNING."""
+    from apex_tpu.obs.slo import SloKnobOverrides
+
+    t = {"now": 0.0}
+    tight = SloKnobOverrides(fast=(10.0, 30.0), breach_after_s=4.0)
+    objs = [SloObjective("tight", "rates.rt", 100.0, "<=", knobs=tight),
+            SloObjective("loose", "rates.rt", 100.0, "<=")]
+    # engine-global knobs: huge fast windows + long damping — 'loose'
+    # cannot breach inside this test's horizon
+    base = SloKnobs(fast=(300.0, 600.0), slow=(600.0, 1200.0),
+                    page_burn=10.0, warn_burn=3.0, breach_after_s=60.0,
+                    resolve_after_s=10.0, ok_after_s=15.0, min_samples=2)
+    eng = SloEngine(objs, knobs=base, clock=lambda: t["now"],
+                    wall=lambda: t["now"])
+    for v in [10.0, 10.0] + [500.0] * 6:
+        eng.sample({"rates": {"rt": v}})
+        t["now"] += 5.0
+    assert eng.state_of("tight") == BREACHED
+    assert eng.state_of("loose") != BREACHED
+    # snapshot burns use each objective's OWN windows
+    snap = {o["name"]: o for o in eng.snapshot()["objectives"]}
+    assert snap["tight"]["burn_fast"] is not None
+
+
+def test_serving_rollbacks_objective_resolves_from_summary():
+    by = {o.name: o for o in default_slos()}
+    o = by["serving_rollbacks"]
+    assert o.threshold is None          # observe-only until opted in
+    assert resolve_signal({"serving": {"rollbacks": 2}},
+                          "serving.rollbacks") == 2.0
+    assert resolve_signal({}, "serving.rollbacks") is None
+    enabled = {o2.name: o2 for o2 in default_slos(
+        environ={"APEX_SLO_SERVING_ROLLBACKS": "0"})}
+    assert enabled["serving_rollbacks"].judge(1) is False  # any rollback
+    assert enabled["serving_rollbacks"].judge(0) is True
+
+
 # -- scale decisions: drain-frac vs slo parity -------------------------------
 
 def test_scale_decision_parity_drain_vs_slo():
