@@ -163,15 +163,23 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     from apex_tpu.obs import spans as obs_spans
     from apex_tpu.obs.trace import get_ring, set_process_label
 
+    from apex_tpu.tenancy import namespace as tenancy_ns
+
     key = jax.random.key(family.seed)
     env = family.env
-    set_process_label(f"actor-{actor_id}")
+    # tenant-qualified identity (PR 13): the worker's beats must agree
+    # with the role-level wire identity (park heartbeats, chunk-arrival
+    # liveness) or a tenant's actor shows up TWICE in its registry;
+    # the default tenant qualifies to the bare name
+    identity = tenancy_ns.qualify(tenancy_ns.current_tenant(),
+                                  f"actor-{actor_id}")
+    set_process_label(identity)
     ring = get_ring()
     # fleet liveness: periodic Heartbeats on the stat channel — the
     # in-host trainer and the socket learner's registry consume the same
     # message (the socket adapters expose wire counters / park state)
     beat = HeartbeatEmitter(
-        f"actor-{actor_id}", role="actor",
+        identity, role="actor",
         interval_s=cfg.comms.heartbeat_interval_s,
         counters_fn=getattr(chunk_queue, "wire_counters", None),
         park_fn=getattr(param_queue, "park_state", None))

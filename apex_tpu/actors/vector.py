@@ -388,7 +388,15 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
     from apex_tpu.obs import spans as obs_spans
     from apex_tpu.obs.trace import get_ring, set_process_label
 
-    set_process_label(f"actor-{actor_id}")
+    from apex_tpu.tenancy import namespace as tenancy_ns
+
+    # tenant-qualified identity (PR 13): the worker's beats must agree
+    # with the role-level wire identity (park heartbeats, chunk-arrival
+    # liveness) or a tenant's actor shows up TWICE in its registry;
+    # the default tenant qualifies to the bare name
+    identity = tenancy_ns.qualify(tenancy_ns.current_tenant(),
+                                  f"actor-{actor_id}")
+    set_process_label(identity)
     ring = get_ring()
     # attach the trace ring to the family's existing timers: every
     # policy-wait/env-step/drain phase and every dispatch gap becomes a
@@ -400,7 +408,7 @@ def vector_worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
 
     key = jax.random.key(family.seeds[0])
     beat = HeartbeatEmitter(
-        f"actor-{actor_id}", role="actor",
+        identity, role="actor",
         interval_s=cfg.comms.heartbeat_interval_s,
         counters_fn=getattr(chunk_queue, "wire_counters", None),
         park_fn=getattr(param_queue, "park_state", None),

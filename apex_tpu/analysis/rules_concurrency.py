@@ -899,3 +899,158 @@ class RawEpochComparison(Rule):
                         f"apex_tpu.serving.fence"))
                     break           # one finding per comparison
         return out
+
+
+# -- J017 -------------------------------------------------------------------
+
+
+@register
+class CrossTenantId(Rule):
+    id = "J017"
+    name = "cross-tenant-id"
+    description = ("a tenant-qualified identifier built by string "
+                   "concatenation/formatting (a tenant value joined to "
+                   "identity/chunk-id/topic parts with the namespace "
+                   "separators '/' or '|') outside the tenancy "
+                   "namespacing helpers (apex_tpu/tenancy/namespace.py): "
+                   "the id grammar — tenant/base identities, "
+                   "identity:seq chunk ids, apxt/<tenant>| param topics "
+                   "— must have exactly ONE construction site, or two "
+                   "planes eventually disagree on where a tenant's data "
+                   "lives and one tenant's traffic lands in another's "
+                   "partition.  Route construction through "
+                   "apex_tpu.tenancy.namespace (qualify/chunk_id/"
+                   "param_topic)")
+
+    #: THE namespacing module: the one place the grammar may be built
+    _EXEMPT = ("apex_tpu/tenancy/namespace.py", "tenancy/namespace.py")
+    #: the grammar's separators; ids join tenant parts with exactly these
+    _SEPS = ("/", "|")
+
+    @staticmethod
+    def _tenant_expr(node: ast.AST) -> bool:
+        """Does this expression carry a tenant value?  Names/attributes
+        spelled ``tenant``/``tenant_*``/``*_tenant`` (the repo's one
+        spelling family — ``spec.tenant``, ``self.tenant``,
+        ``spec_tenant``), including conversion wrappers like
+        ``str(tenant)``."""
+        if isinstance(node, ast.Call) and len(node.args) == 1 \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in ("str", "format"):
+            return CrossTenantId._tenant_expr(node.args[0])
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is None:
+            return False
+        return (name == "tenant" or name.startswith("tenant_")
+                or name.endswith("_tenant"))
+
+    @classmethod
+    def _sep_literal(cls, node: ast.AST, side: str) -> bool:
+        """Is ``node`` a string literal that joins with a grammar
+        separator on the given side ('head' = starts with one — the
+        literal FOLLOWS the tenant; 'tail' = ends with one — the
+        literal PRECEDES the tenant)?"""
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str) and node.value):
+            return False
+        ch = node.value[0] if side == "head" else node.value[-1]
+        return ch in cls._SEPS
+
+    def _check_joinedstr(self, node: ast.JoinedStr) -> bool:
+        """f-string: a tenant-ish hole with a separator literal
+        immediately adjacent (f"{tenant}/..." or f"...|{tenant}...")."""
+        parts = node.values
+        for i, part in enumerate(parts):
+            if not (isinstance(part, ast.FormattedValue)
+                    and self._tenant_expr(part.value)):
+                continue
+            if i + 1 < len(parts) \
+                    and self._sep_literal(parts[i + 1], "head"):
+                return True
+            if i > 0 and self._sep_literal(parts[i - 1], "tail"):
+                return True
+        return False
+
+    def _check_binop(self, node: ast.BinOp) -> bool:
+        """Concat chain: flatten +-chains of strings and look for a
+        tenant operand adjacent to a separator literal."""
+        if not isinstance(node.op, ast.Add):
+            return False
+        flat: list[ast.AST] = []
+
+        def walk(n: ast.AST) -> None:
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                walk(n.left)
+                walk(n.right)
+            else:
+                flat.append(n)
+
+        walk(node)
+        for i, part in enumerate(flat):
+            if not self._tenant_expr(part):
+                continue
+            if i + 1 < len(flat) and self._sep_literal(flat[i + 1],
+                                                       "head"):
+                return True
+            if i > 0 and self._sep_literal(flat[i - 1], "tail"):
+                return True
+        return False
+
+    def _check_call(self, node: ast.Call) -> bool:
+        """``"/".join([..tenant..])`` and
+        ``"{}/{}".format(tenant, ...)`` shapes."""
+        f = node.func
+        if not isinstance(f, ast.Attribute) \
+                or not (isinstance(f.value, ast.Constant)
+                        and isinstance(f.value.value, str)):
+            return False
+        lit = f.value.value
+        if f.attr == "join" and lit in self._SEPS:
+            for arg in node.args:
+                elts = (arg.elts if isinstance(arg, (ast.List, ast.Tuple))
+                        else [arg])
+                if any(self._tenant_expr(e) for e in elts):
+                    return True
+        if f.attr == "format" and any(s in lit for s in self._SEPS):
+            if any(self._tenant_expr(a) for a in node.args) \
+                    or any(self._tenant_expr(k.value)
+                           for k in node.keywords):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        import os as _os
+        path = ctx.path.replace(_os.sep, "/")
+        if path.endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        # one finding per concat CHAIN: sub-chains of an already-checked
+        # Add chain are skipped (walk yields both)
+        inner_adds: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                          ast.Add):
+                for child in (node.left, node.right):
+                    if isinstance(child, ast.BinOp) \
+                            and isinstance(child.op, ast.Add):
+                        inner_adds.add(id(child))
+        for node in ast.walk(ctx.tree):
+            hit = False
+            if isinstance(node, ast.JoinedStr):
+                hit = self._check_joinedstr(node)
+            elif isinstance(node, ast.BinOp) and id(node) not in inner_adds:
+                hit = self._check_binop(node)
+            elif isinstance(node, ast.Call):
+                hit = self._check_call(node)
+            if hit:
+                out.append(ctx.finding(
+                    self, node,
+                    "tenant-qualified id built outside the namespacing "
+                    "helpers — the tenant/id grammar has ONE "
+                    "construction site; use apex_tpu.tenancy.namespace "
+                    "(qualify/chunk_id/param_topic)"))
+        return out

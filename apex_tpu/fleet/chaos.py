@@ -96,7 +96,28 @@ class ChaosConfig:
         self.respawn_count = respawn_count
 
     def plan_for(self, identity: str) -> ChaosPlan:
-        kill = self.spec.get("kill", {}).get(identity)
+        from apex_tpu.tenancy import namespace as tenancy_ns
+
+        # tenant-scoped targeting (PR 13): a spec with a "tenant" field
+        # applies ONLY to that tenant's peers (parsed off the namespaced
+        # identity) — and its kill/mute/skew/score_bias keys may then
+        # name the BARE role id ("actor-0" hits "rally/actor-0"), so a
+        # drill can blast one tenant with zero radius into its
+        # neighbors.  Without the field, behavior is exactly pre-tenancy
+        # (full-identity matching, every tenant exposed alike).
+        spec_tenant = self.spec.get("tenant")
+        tenant, base = tenancy_ns.split(identity)
+        if spec_tenant and tenant != spec_tenant:
+            return ChaosPlan(seed=self.seed, identity=identity)  # no-op
+
+        def lookup(table: dict):
+            if identity in table:
+                return table[identity]
+            if spec_tenant and base in table:
+                return table[base]
+            return None
+
+        kill = lookup(self.spec.get("kill", {}))
         if self.respawn_count > 0:
             kill = None             # kills are first-life only (see above)
         aw = self.spec.get("ack_withhold") or {}
@@ -106,9 +127,12 @@ class ChaosConfig:
         sb = None
         for key, entry in sorted((self.spec.get("score_bias")
                                   or {}).items()):
-            if identity.startswith(key):
+            if identity.startswith(key) \
+                    or (spec_tenant and base.startswith(key)):
                 sb = entry
                 break
+        mute = self.spec.get("mute", ())
+        skew = lookup(self.spec.get("epoch_skew", {}))
         return ChaosPlan(
             seed=self.seed, identity=identity,
             kill_at=kill,
@@ -120,9 +144,9 @@ class ChaosConfig:
             ack_withhold_at=aw.get("at"),
             ack_withhold_n=int(aw.get("n", 1)),
             ack_withhold_s=float(aw.get("hold_s", 3.0)),
-            mute_replies=identity in self.spec.get("mute", ()),
-            epoch_skew=int(self.spec.get("epoch_skew", {})
-                           .get(identity, 0)),
+            mute_replies=(identity in mute
+                          or bool(spec_tenant and base in mute)),
+            epoch_skew=int(skew or 0),
             score_bias_after_s=(None if sb is None
                                 else float(sb.get("after_s", 0.0))),
             score_bias_delta=(0.0 if sb is None

@@ -55,6 +55,10 @@ def _min_transit_offset(samples) -> float:
 class PeerState:
     identity: str
     role: str = "?"
+    # owning tenant, parsed from the (possibly tenant-qualified) wire
+    # identity at first sight (tenancy/namespace.py); unqualified peers
+    # belong to the default tenant — every pre-tenancy fleet unchanged
+    tenant: str = ""
     pid: int = 0
     host: str = ""
     state: str = JOINING
@@ -108,9 +112,12 @@ class FleetRegistry:
     def _peer(self, identity: str) -> PeerState:
         p = self.peers.get(identity)
         if p is None:
+            from apex_tpu.tenancy import namespace as tenancy_ns
             now = self._clock()
             p = self.peers[identity] = PeerState(
-                identity=identity, joined_at=now, last_any=now)
+                identity=identity,
+                tenant=tenancy_ns.tenant_of(identity),
+                joined_at=now, last_any=now)
         return p
 
     def _revive(self, p: PeerState) -> None:
@@ -244,7 +251,8 @@ class FleetRegistry:
         now = self._clock()
         with self._lock:
             peers = [{
-                "identity": p.identity, "role": p.role, "state": p.state,
+                "identity": p.identity, "tenant": p.tenant,
+                "role": p.role, "state": p.state,
                 "pid": p.pid, "host": p.host, "fps": p.fps,
                 "param_version": p.param_version,
                 "chunks_sent": p.chunks_sent,
@@ -261,15 +269,28 @@ class FleetRegistry:
 
 
 def format_fleet_table(snapshot: dict) -> str:
-    """Human fleet table for ``--role status``."""
+    """Human fleet table for ``--role status``.  Peers group by tenant
+    (multi-tenant fleets get one block per tenant, default first); a
+    single-tenant fleet renders exactly the pre-tenancy table."""
+    from apex_tpu.tenancy import namespace as tenancy_ns
+
     cols = ("identity", "role", "state", "pid", "host", "fps",
             "param_version", "chunks_sent", "rejoins", "parked", "silent_s")
-    rows = [[str(p.get(c, "")) for c in cols] for p in snapshot["peers"]]
+    peers = list(snapshot["peers"])
+    tenants = sorted({p.get("tenant") or tenancy_ns.DEFAULT_TENANT
+                      for p in peers},
+                     key=lambda t: (not tenancy_ns.is_default(t), t))
+    rows = [[str(p.get(c, "")) for c in cols] for p in peers]
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
     lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
-    for r in rows:
-        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    for tenant in tenants:
+        if len(tenants) > 1:
+            lines.append(f"-- tenant {tenant} --")
+        for p, r in zip(peers, rows):
+            if (p.get("tenant") or tenancy_ns.DEFAULT_TENANT) != tenant:
+                continue
+            lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
     m = snapshot.get("metrics", {})
     lines.append("")
     lines.append(
@@ -301,6 +322,13 @@ def format_fleet_table(snapshot: dict) -> str:
     if serving:
         from apex_tpu.serving.deploy import format_serving_lines
         lines.extend(format_serving_lines(serving))
+    # multi-tenant plane (apex_tpu/tenancy): admissions, per-tenant
+    # bands/placement, and the tenancy timeline tail — the operator
+    # table answers "who shares this fleet and who owns which band"
+    tenancy = snapshot.get("tenancy")
+    if tenancy:
+        from apex_tpu.tenancy.scheduler import format_tenancy_lines
+        lines.extend(format_tenancy_lines(tenancy))
     return "\n".join(lines)
 
 

@@ -70,9 +70,16 @@ class InferClient:
                  port: int | None = None):
         import zmq
 
+        from apex_tpu.tenancy import namespace as tenancy_ns
+
         self._zmq = zmq
         self.comms = comms
         self.identity = identity
+        # this worker's tenant (PR 13): stamped on every request so the
+        # shared server coalesces per (tenant, group) and dispatches
+        # under OUR learner's params; the default tenant stays
+        # unstamped — the pre-tenancy request schema, byte for byte
+        self.tenant = tenancy_ns.current_tenant()
         self._clock = clock
         # sharded serving tier (apex_tpu/serving/shard): the home-shard
         # index make_infer_client stamps after construction — 0 for the
@@ -141,10 +148,13 @@ class InferClient:
         t0 = self._clock()
         sent = False
         if self._remote_ok():
+            from apex_tpu.tenancy import namespace as tenancy_ns
             msg = {"rid": rid, "obs": np.asarray(obs),
                    "eps": np.asarray(eps, np.float32),
                    "key": np.asarray(jax.random.key_data(key)),
                    "group": int(group)}
+            if not tenancy_ns.is_default(self.tenant):
+                msg["tenant"] = self.tenant
             if obs_spans.enabled():
                 msg[obs_spans.SPAN_KEY] = [
                     obs_spans.new_span(hop="infer_send")]

@@ -63,6 +63,7 @@ from apex_tpu.obs import spans as obs_spans
 from apex_tpu.obs.spans import LatencyHistogram
 from apex_tpu.runtime import wire
 from apex_tpu.serving import fence
+from apex_tpu.tenancy import namespace as tenancy_ns
 
 
 def quantize_pow2(n: int, cap: int) -> int:
@@ -165,6 +166,15 @@ class InferServer:
         self.held = 0                   # installs refused by the pin
         self.gate_rollbacks = 0         # incumbent restores taken
         self.ctl_cmds = 0
+        # tenant entries (PR 13): each non-default tenant served here
+        # gets its OWN params/version/epoch/compiled-policy/subscriber —
+        # requests coalesce per (tenant, shape), so one tenant's batch
+        # never runs under another's params.  The default tenant stays
+        # on the attributes above, bit-identical to the single-tenant
+        # server; the serve-ctl version gate also governs only the
+        # default tenant (per-tenant canaries are a ROADMAP follow-up).
+        self.tenants: dict[str, dict] = {}
+        self.unknown_tenant = 0
         # serving counters / gauges (heartbeats + stats())
         self.requests = 0
         self.replies = 0
@@ -305,14 +315,46 @@ class InferServer:
             return params           # host arrays ARE the device arrays
         return jax.device_put(params)
 
+    # -- tenants (PR 13) -----------------------------------------------------
+
+    def add_tenant(self, tenant: str, policy_fn, sub=None) -> None:
+        """Serve one more tenant from this shard: its own compiled
+        policy (its env's model — obs geometry and action count differ
+        per tenant) and, optionally, a subscriber on ITS learner's
+        param channel.  Direct installs come via
+        :meth:`set_tenant_params` (tests, co-located trainers)."""
+        if tenancy_ns.is_default(tenant):
+            return                  # the default tenant IS the server
+        self.tenants[tenant] = {
+            "batched": make_batched_policy(policy_fn),
+            "sub": sub, "params": None, "version": 0, "epoch": 0}
+
+    def set_tenant_params(self, tenant: str, version: int, params,
+                          epoch: int = 0) -> None:
+        entry = self.tenants[tenant]
+        entry["params"] = self._placed(params)
+        entry["version"] = int(version)
+        if epoch:
+            entry["epoch"] = int(epoch)
+
     def _poll_params(self) -> None:
-        if self.sub is None:
-            return
-        got = self.sub.poll(0)
-        if got is not None:
-            version, params = got
-            self.set_params(version, params,
-                            epoch=getattr(self.sub, "learner_epoch", 0))
+        if self.sub is not None:
+            got = self.sub.poll(0)
+            if got is not None:
+                version, params = got
+                self.set_params(version, params,
+                                epoch=getattr(self.sub, "learner_epoch",
+                                              0))
+        for tenant, entry in self.tenants.items():
+            sub = entry["sub"]
+            if sub is None:
+                continue
+            got = sub.poll(0)
+            if got is not None:
+                version, params = got
+                self.set_tenant_params(
+                    tenant, version, params,
+                    epoch=getattr(sub, "learner_epoch", 0))
 
     # -- serving -------------------------------------------------------------
 
@@ -328,13 +370,6 @@ class InferServer:
         pending = self._coalesce()
         if not pending:
             return 0
-        if self.params is None:
-            # no publish yet: tell the clients to act locally NOW rather
-            # than letting them wait out infer_wait_s
-            for ident, msg, _ in pending:
-                self.dry_replies += 1
-                self._reply(ident, ("dry", {"rid": msg["rid"]}))
-            return len(pending)
         served = 0
         for group in self._group_by_shape(pending):
             served += self._dispatch(group)
@@ -386,19 +421,48 @@ class InferServer:
 
     @staticmethod
     def _group_by_shape(pending: list) -> list[list]:
-        """Same-shaped requests share one scan dispatch (a scan needs one
-        stacked geometry; a fleet of like-configured actors produces at
-        most the two half-group widths)."""
-        by_shape: dict[tuple, list] = {}
+        """Same-tenant, same-shaped requests share one scan dispatch (a
+        scan needs one stacked geometry AND one params pytree: the
+        tenant key is what guarantees one tenant's batch never runs
+        under another's params).  A like-configured single-tenant fleet
+        produces at most the two half-group widths, exactly as
+        before."""
+        by_key: dict[tuple, list] = {}
         for item in pending:
-            by_shape.setdefault(item[1]["obs"].shape, []).append(item)
-        return list(by_shape.values())
+            tenant = str(item[1].get("tenant")
+                         or tenancy_ns.DEFAULT_TENANT)
+            by_key.setdefault((tenant, item[1]["obs"].shape),
+                              []).append(item)
+        return list(by_key.values())
+
+    def _dry_group(self, group: list) -> int:
+        """No params for this group's tenant yet: tell its clients to
+        act locally NOW rather than letting them wait out
+        infer_wait_s."""
+        for ident, msg, _ in group:
+            self.dry_replies += 1
+            self._reply(ident, ("dry", {"rid": msg["rid"]}))
+        return len(group)
 
     def _dispatch(self, group: list) -> int:
-        """One scan-stacked device dispatch over ``group`` (same obs
-        shape), padded to a pow2-quantized length by repeating the last
-        request — each scan step depends only on its own inputs, so the
-        padding changes compile count, never results."""
+        """One scan-stacked device dispatch over ``group`` (same tenant
+        + obs shape), padded to a pow2-quantized length by repeating the
+        last request — each scan step depends only on its own inputs, so
+        the padding changes compile count, never results."""
+        tenant = str(group[0][1].get("tenant")
+                     or tenancy_ns.DEFAULT_TENANT)
+        if tenancy_ns.is_default(tenant):
+            params, batched = self.params, self.batched
+            pv, epoch = self.param_version, self.learner_epoch
+        else:
+            entry = self.tenants.get(tenant)
+            if entry is None:
+                self.unknown_tenant += 1    # unadmitted tenant: its
+                return self._dry_group(group)   # clients act locally
+            params, batched = entry["params"], entry["batched"]
+            pv, epoch = entry["version"], entry["epoch"]
+        if params is None:
+            return self._dry_group(group)
         n = len(group)
         width = quantize_pow2(n, self.comms.infer_batch_max)
         idx = list(range(n)) + [n - 1] * (width - n)
@@ -408,7 +472,7 @@ class InferServer:
         keys = np.stack([np.asarray(group[i][1]["key"]) for i in idx])
         groups = np.asarray([int(group[i][1]["group"]) for i in idx],
                             np.int32)
-        actions, q = self.batched(self.params, obs, eps, keys, groups)
+        actions, q = batched(params, obs, eps, keys, groups)
         actions, q = np.asarray(actions), np.asarray(q)
         self.dispatches += 1
         self.batch_hist.record(float(n))
@@ -416,8 +480,7 @@ class InferServer:
         for r, (ident, msg, t_recv) in enumerate(group):
             self.coalesce_hist.record(max(0.0, now - t_recv))
             reply = {"rid": msg["rid"], "actions": actions[r], "q": q[r],
-                     "pv": self.param_version,
-                     "epoch": self.learner_epoch}
+                     "pv": pv, "epoch": epoch}
             spans = msg.get(obs_spans.SPAN_KEY)
             if spans:
                 obs_spans.stamp_spans(spans, "infer_reply")
@@ -452,11 +515,14 @@ class InferServer:
     def gauges(self) -> dict:
         """The serving gauges heartbeats carry to the registry (status
         table + Prometheus exposition)."""
+        import jax
         b, c = self.batch_hist.snapshot(), self.coalesce_hist.snapshot()
         # serve_* rows: the registry's per-shard pinned-version view —
         # the deployment controller's reconcile target is auditable from
         # `--role status` without a ctl round-trip
-        return {"queue_depth": self._queue_depth,
+        return {"tenants": 1 + len(self.tenants),
+                "backend_accel": float(jax.default_backend() != "cpu"),
+                "queue_depth": self._queue_depth,
                 "batch_p50": b["p50_s"], "batch_p90": b["p90_s"],
                 "coalesce_ms_p50": round(c["p50_s"] * 1000.0, 3),
                 "requests": self.requests, "replies": self.replies,
@@ -484,6 +550,9 @@ class InferServer:
             self._hb_sender.close(drain_s=0.0)
         if self.sub is not None:
             self.sub.close()
+        for entry in self.tenants.values():
+            if entry["sub"] is not None:
+                entry["sub"].close()
 
 
 def dqn_policy_fn(cfg: ApexConfig):
@@ -520,12 +589,34 @@ def run_infer_server(cfg: ApexConfig, family: str = "dqn",
             f"--infer-shards/APEX_INFER_SHARDS fleet-wide")
     set_process_label(f"infer-{server_id}")
     get_ring()                      # arm the trace ring's dump triggers
-    sub = transport.ParamSubscriber(cfg.comms)
+    # explicit empty topic: the infer shard is SHARED-plane — its base
+    # subscriber always serves the default tenant's channel, even if an
+    # operator leaks APEX_TENANT into the server's environment
+    sub = transport.ParamSubscriber(cfg.comms, topic=b"")
     server = InferServer(cfg.comms, dqn_policy_fn(cfg),
                          server_id=server_id, bind_ip=bind_ip, sub=sub,
                          port=shard_port(cfg.comms, server_id))
+    # tenant entries (PR 13): one compiled policy + one param SUB per
+    # roster tenant — the SUB connects that tenant's OWN learner
+    # endpoint and subscribes its topic tag, so requests coalesced per
+    # (tenant, group) always dispatch under the right tenant's params
+    import dataclasses
+    roster = tenancy_ns.load_roster()
+    for tenant, spec in sorted(roster.items()):
+        if spec.family != "dqn":
+            print(f"infer-{server_id}: tenant {tenant!r} skipped "
+                  f"(family {spec.family!r} unserved — ROADMAP.md)",
+                  flush=True)
+            continue
+        tcfg = cfg.replace(env=dataclasses.replace(cfg.env,
+                                                   env_id=spec.env_id))
+        tsub = transport.ParamSubscriber(
+            tenancy_ns.tenant_comms(cfg.comms, spec),
+            topic=tenancy_ns.param_topic(tenant))
+        server.add_tenant(tenant, dqn_policy_fn(tcfg), sub=tsub)
     print(f"infer-{server_id}: serving on port {server.port} "
           f"(shard {server_id}/{n_shards}, "
+          f"tenants=1+{len(server.tenants)}, "
           f"batch_max={cfg.comms.infer_batch_max}, "
           f"window_ms={cfg.comms.infer_window_ms}, "
           f"device_params={cfg.comms.infer_device_params})", flush=True)

@@ -45,6 +45,10 @@ REGISTERED_GAUGES = frozenset({
     # evaluator eval-ladder scores (runtime/roles.py — the SLO engine's
     # model-quality signal and the future canary/promotion gate input)
     "eval_band", "eval_episodes", "eval_score_last", "eval_score_mean",
+    # multi-tenant plane (apex_tpu/tenancy): partition/entry counts on
+    # shared-plane beats, the host's accelerator flag (the placement
+    # scheduler's 2311.09445 input)
+    "tenants", "backend_accel",
 })
 
 #: Declared Prometheus exposition families: the fixed row names the
@@ -70,6 +74,11 @@ REGISTERED_FAMILIES = frozenset({
     "serving_rollbacks", "serving_canary_shards",
     "serving_incumbent_epoch", "serving_incumbent_version",
     "serving_shard_pinned", "serving_shard_version",
+    # tenancy rows (tenancy/scheduler.py prometheus_sections): the
+    # placement controller's admission counts + per-tenant state/bands
+    "tenancy_tenants", "tenancy_admissions", "tenancy_evictions",
+    "tenancy_rebalances", "tenancy_tenant_state",
+    "tenancy_tenant_shards",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -152,6 +161,7 @@ def render_fleet(snapshot: dict, prefix: str = "apex") -> tuple[dict, dict]:
     gauges = {f"fleet_{k}": v for k, v in m.items() if v is not None}
     labeled = {
         "fleet_peer_up": [({"identity": p["identity"], "role": p["role"],
+                            "tenant": p.get("tenant") or "t0",
                             "state": p["state"]},
                            1.0 if p["state"] == "ALIVE" else 0.0)
                           for p in snapshot.get("peers", [])],

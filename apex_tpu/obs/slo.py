@@ -226,17 +226,38 @@ def default_slos(actor_dead_thresh: float | None = None,
 # -- signal resolution -------------------------------------------------------
 
 
+def _tenant_split(path: str) -> tuple[str, str | None]:
+    """Peel an optional ``@tenant`` suffix off a peer-walking signal
+    path (PR 13): ``derived.dead_frac.actor@rally`` judges ONLY the
+    rally tenant's actors — the per-tenant SLO dimension on a shared
+    fleet's registry.  No suffix = all tenants, the pre-tenancy
+    semantics."""
+    if "@" in path:
+        head, tenant = path.rsplit("@", 1)
+        return head, tenant
+    return path, None
+
+
+def _tenant_match(peer: dict, tenant: str | None) -> bool:
+    if tenant is None:
+        return True
+    return (peer.get("tenant") or "t0") == tenant
+
+
 def resolve_signal(summary: dict, path: str):
     """Resolve one signal path against a fleet-summary-shaped dict;
     ``None`` for anything missing/non-numeric (a missing signal is a
     skipped verdict, never a crash — observability must not take the
-    learner down)."""
+    learner down).  Peer-walking paths (``gauge:``/``derived.``) accept
+    an ``@tenant`` suffix restricting the walk to one tenant's peers."""
     try:
+        path, tenant = _tenant_split(path)
         if path.startswith("gauge:"):
             _, role, gauge, agg = path.split(":")
             vals = []
             for p in summary.get("peers") or []:
-                if p.get("role") != role or p.get("state") == "DEAD":
+                if p.get("role") != role or p.get("state") == "DEAD" \
+                        or not _tenant_match(p, tenant):
                     continue
                 v = (p.get("gauges") or {}).get(gauge)
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -253,14 +274,16 @@ def resolve_signal(summary: dict, path: str):
         if path.startswith("derived.dead_frac."):
             role = path.rsplit(".", 1)[-1]
             peers = [p for p in summary.get("peers") or []
-                     if role == "all" or p.get("role") == role]
+                     if (role == "all" or p.get("role") == role)
+                     and _tenant_match(p, tenant)]
             if not peers:
                 return None
             return sum(p.get("state") == "DEAD" for p in peers) / len(peers)
         if path.startswith("derived.role_fps."):
             role = path.rsplit(".", 1)[-1]
             peers = [p for p in summary.get("peers") or []
-                     if p.get("role") == role]
+                     if p.get("role") == role
+                     and _tenant_match(p, tenant)]
             if not peers:
                 return None
             return sum(float(p.get("fps", 0.0)) for p in peers

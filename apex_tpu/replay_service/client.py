@@ -43,11 +43,19 @@ class ReplayServiceClient:
                  retry_s: float = 2.0):
         import zmq
 
+        from apex_tpu.tenancy import namespace as tenancy_ns
+
         self._zmq = zmq
         self.comms = comms
         self.n_shards = n_shards or comms.replay_shards
         if self.n_shards <= 0:
             raise ValueError("ReplayServiceClient needs replay_shards > 0")
+        # multi-tenant shards (PR 13): this learner's tenant rides every
+        # pull/write-back so the shard routes to OUR partition; the
+        # DEALER identities qualify too — two tenants' learners on one
+        # shared shard ROUTER must never collide on "learner-0"
+        self.tenant = tenancy_ns.current_tenant()
+        identity = tenancy_ns.qualify(self.tenant, identity)
         ip = replay_ip or comms.replay_ip
         ctx = zmq.Context.instance()
         self.socks = []
@@ -89,8 +97,14 @@ class ReplayServiceClient:
             return
         if self._outstanding[s]:
             self.unanswered[s] += 1     # retry: the last pull went silent
-        msg = (("pull", self.learner_epoch) if self.learner_epoch
-               else ("pull",))
+        from apex_tpu.tenancy import namespace as tenancy_ns
+        if not tenancy_ns.is_default(self.tenant):
+            # tenant pulls always carry the name (epoch may be 0);
+            # default-tenant pulls keep the legacy 1/2-tuple format
+            msg = ("pull", self.learner_epoch, self.tenant)
+        else:
+            msg = (("pull", self.learner_epoch) if self.learner_epoch
+                   else ("pull",))
         try:
             self.socks[s].send(wire.dumps(msg), self._zmq.DONTWAIT)
             self._outstanding[s] = True
@@ -160,12 +174,14 @@ class ReplayServiceClient:
         forgives them server-side), never wedge the learner.  Each
         write-back carries the learner epoch (plus any chaos skew) so a
         restarted learner's shards can fence its predecessor's ghosts."""
+        from apex_tpu.tenancy import namespace as tenancy_ns
         epoch = (max(0, self.learner_epoch + self.epoch_skew)
                  if self.learner_epoch else 0)
-        payload = wire.dumps(("prio", int(seq),
-                              np.asarray(idx),
-                              np.asarray(priorities, np.float32),
-                              int(epoch)))
+        msg = ("prio", int(seq), np.asarray(idx),
+               np.asarray(priorities, np.float32), int(epoch))
+        if not tenancy_ns.is_default(self.tenant):
+            msg = msg + (self.tenant,)
+        payload = wire.dumps(msg)
         try:
             self.socks[int(shard)].send(payload, self._zmq.DONTWAIT)
             self.prio_sent += 1

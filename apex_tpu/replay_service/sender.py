@@ -92,9 +92,14 @@ class ShardedChunkSender:
         liveness exactly as in the unsharded topology."""
         import time
 
+        from apex_tpu.tenancy import namespace as tenancy_ns
         cid = msg.get("chunk_id")
         if cid is None:
-            cid = msg["chunk_id"] = f"{self.identity}:{self._seq}"
+            # canonical identity:seq grammar (tenancy/namespace.py): the
+            # identity is already tenant-qualified by the role, so the
+            # crc32 below partitions per tenant with no extra machinery
+            cid = msg["chunk_id"] = tenancy_ns.chunk_id(self.identity,
+                                                        self._seq)
         self._seq += 1
         s = chunk_shard(cid, self.n_shards)
         wait = self.shard_wait_s

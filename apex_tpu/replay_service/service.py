@@ -47,6 +47,7 @@ from apex_tpu.config import ApexConfig, CommsConfig
 from apex_tpu.obs import spans as obs_spans
 from apex_tpu.replay_service.shard import ReplayShardCore
 from apex_tpu.runtime import wire
+from apex_tpu.tenancy import namespace as tenancy_ns
 
 
 def shard_warmup(global_warmup: int, n_shards: int) -> int:
@@ -74,11 +75,17 @@ def dqn_replay_spec(cfg: ApexConfig):
     return replay
 
 
-def build_shard_core(cfg: ApexConfig, shard_id: int,
-                     family: str = "dqn") -> ReplayShardCore:
+def build_shard_core(cfg: ApexConfig, shard_id: int, family: str = "dqn",
+                     tenant_spec=None) -> ReplayShardCore:
     """One shard's core from the fleet config.  ``capacity``/``warmup``
     are per shard (capacity as configured — N shards hold N x capacity;
-    warmup split so the global gate is preserved)."""
+    warmup split so the global gate is preserved).
+
+    ``tenant_spec`` (PR 13) builds a TENANT PARTITION instead: its own
+    FramePoolReplay sized from the tenant's env id, its own warmup/beta
+    math over its own ingest count, its admission quota, and a PRNG
+    chain folded by the tenant name — the default-tenant core (spec
+    None) is constructed exactly as before, bit for bit."""
     import jax
 
     if family != "dqn":
@@ -86,9 +93,26 @@ def build_shard_core(cfg: ApexConfig, shard_id: int,
             f"replay service shards currently serve the dqn family only "
             f"(got {family!r}); aql/r2d2 stay on in-learner replay — see "
             f"ROADMAP.md")
+    quota = 0
+    if tenant_spec is not None:
+        if tenant_spec.family != "dqn":
+            raise NotImplementedError(
+                f"tenant {tenant_spec.name!r}: replay partitions serve "
+                f"the dqn family only (got {tenant_spec.family!r})")
+        import dataclasses
+        cfg = cfg.replace(env=dataclasses.replace(
+            cfg.env, env_id=tenant_spec.env_id))
+        quota = tenant_spec.replay_quota
     replay = dqn_replay_spec(cfg)
     n = max(1, cfg.comms.replay_shards)
     key = jax.random.key(cfg.env.seed + 977_000 + shard_id)
+    if tenant_spec is not None and not tenancy_ns.is_default(
+            tenant_spec.name):
+        import zlib
+        # a tenant-distinct chain: the default core's key untouched, the
+        # partition's deterministically derived from the tenant name
+        key = jax.random.fold_in(
+            key, zlib.crc32(tenant_spec.name.encode()) % (2 ** 31))
     return ReplayShardCore(
         replay, key,
         batch_size=cfg.learner.batch_size,
@@ -96,7 +120,8 @@ def build_shard_core(cfg: ApexConfig, shard_id: int,
         beta=cfg.replay.beta, beta_anneal=cfg.replay.beta_anneal,
         n_shards=n,
         strict_order=cfg.comms.replay_strict_order,
-        presample_depth=cfg.comms.replay_presample)
+        presample_depth=cfg.comms.replay_presample,
+        quota=quota)
 
 
 class _ShardChaos:
@@ -134,7 +159,7 @@ class ReplayShardServer:
     def __init__(self, comms: CommsConfig, shard_id: int,
                  core: ReplayShardCore, bind_ip: str = "*",
                  heartbeat=True, snapshot_path: str | None = None,
-                 snapshot_s: float | None = None):
+                 snapshot_s: float | None = None, tenant_factory=None):
         import zmq
 
         from apex_tpu.fleet.chaos import chaos_from_env
@@ -143,13 +168,24 @@ class ReplayShardServer:
         self.comms = comms
         self.shard_id = int(shard_id)
         self.core = core
+        # per-tenant partitions (PR 13): the default tenant's core IS
+        # `core` (every single-tenant path bit-identical); roster
+        # tenants' partitions build lazily via `tenant_factory(tenant)`
+        # on their first chunk/pull, each its own FramePoolReplay +
+        # PRNG chain + warmup/quota math.  Traffic from a tenant the
+        # factory refuses is counted and refused (acked — a stranger's
+        # credit window must not wedge on the shared plane).
+        self.cores: dict[str, ReplayShardCore] = {
+            tenancy_ns.DEFAULT_TENANT: core}
+        self._tenant_factory = tenant_factory
+        self.unknown_tenant = 0
         self.identity = f"replay-{shard_id}"
         self.sock = zmq.Context.instance().socket(zmq.ROUTER)
         self.sock.bind(f"tcp://{bind_ip}:{comms.replay_port_base + shard_id}")
         self.rejected = 0
         self.batches_served = 0
-        self._inbox: list = []          # strict-mode deferred (ident, msg)
-        self._last_wb = time.monotonic()
+        self._inbox: list = []   # strict-mode deferred (tenant, ident, msg)
+        self._last_wb = {tenancy_ns.DEFAULT_TENANT: time.monotonic()}
         # shard durability: periodic whole-state snapshots (taken only at
         # quiescent points so a restore resumes the strict lockstep
         # bit-exactly); a supervised respawn restores the newest one
@@ -178,48 +214,95 @@ class ReplayShardServer:
                 interval_s=comms.heartbeat_interval_s,
                 counters_fn=lambda: {
                     "chunks_sent": self.batches_served,
-                    "acks_received": self.core.wb_applied})
+                    "acks_received": sum(c.wb_applied
+                                         for c in self.cores.values())},
+                gauges_fn=self._gauges)
 
     # -- message handlers ----------------------------------------------------
+
+    def _core_for(self, tenant: str) -> ReplayShardCore | None:
+        """This tenant's partition, built lazily from the factory on
+        first sight; None for tenants nobody admitted."""
+        got = self.cores.get(tenant)
+        if got is None and self._tenant_factory is not None:
+            got = self._tenant_factory(tenant)
+            if got is not None:
+                self.cores[tenant] = got
+                self._last_wb[tenant] = time.monotonic()
+                print(f"{self.identity}: tenant partition for "
+                      f"{tenant!r} (warmup={got.warmup}, "
+                      f"quota={got.quota or 'unlimited'})", flush=True)
+        return got
+
+    def _ingest(self, core: ReplayShardCore, ident: bytes,
+                msg: dict) -> None:
+        core.ingest_msg(msg)
+        if self._hb is not None:
+            self._hb.tick(int(msg.get("n_trans", 0)))
+        self.sock.send_multipart([ident, b"ack"])
 
     def _handle_chunk(self, ident: bytes, msg: dict) -> None:
         if self.chaos.on_chunk() == "drop":
             self.sock.send_multipart([ident, b"ack"])   # silent data loss
             return
         obs_spans.stamp(msg, "shard_recv")
-        if not self.core.can_ingest():
-            self._inbox.append((ident, msg))            # ack withheld:
+        tenant = tenancy_ns.tenant_of(str(msg.get("chunk_id") or ""))
+        core = self._core_for(tenant)
+        if core is None:
+            self.unknown_tenant += 1    # unadmitted tenant: refused, but
+            self.sock.send_multipart([ident, b"ack"])   # never wedged
+            return
+        if core.over_quota():
+            core.quota_dropped += 1     # quota-bounded ingest: a full
+            self.sock.send_multipart([ident, b"ack"])   # partition refuses
+            return
+        if not core.can_ingest():
+            self._inbox.append((tenant, ident, msg))    # ack withheld:
             return                                      # credit paces sender
-        self.core.ingest_msg(msg)
-        if self._hb is not None:
-            self._hb.tick(int(msg.get("n_trans", 0)))
-        self.sock.send_multipart([ident, b"ack"])
+        self._ingest(core, ident, msg)
 
     def _drain_inbox(self) -> None:
-        while self._inbox and self.core.can_ingest():
-            ident, msg = self._inbox.pop(0)
-            self.core.ingest_msg(msg)
-            if self._hb is not None:
-                self._hb.tick(int(msg.get("n_trans", 0)))
-            self.sock.send_multipart([ident, b"ack"])
+        """Ingest deferred chunks whose tenant partition can take them
+        now (per-entry gate: strict mode re-closes after one ingest, so
+        later same-tenant entries stay parked — single-tenant behavior
+        unchanged).  FIFO order preserved per tenant."""
+        rest: list = []
+        for tenant, ident, msg in self._inbox:
+            core = self.cores.get(tenant)
+            if core is not None and core.can_ingest():
+                self._ingest(core, ident, msg)
+            else:
+                rest.append((tenant, ident, msg))
+        self._inbox = rest
 
-    def _handle_pull(self, ident: bytes, epoch: int = 0) -> None:
-        forgiven = self.core.note_epoch(int(epoch))
+    def _handle_pull(self, ident: bytes, epoch: int = 0,
+                     tenant: str = tenancy_ns.DEFAULT_TENANT) -> None:
+        core = self._core_for(tenant)
+        if core is None:
+            self.unknown_tenant += 1
+            reply = ("dry", {"ingested": 0, "warm": False,
+                             "stale_wb": 0, "restored": 0})
+            if not self._mute:
+                self.sock.send_multipart([ident, wire.dumps(reply)])
+            else:
+                self.chaos_muted += 1
+            return
+        forgiven = core.note_epoch(int(epoch))
         if forgiven:
             # a restarted learner's first pull: its predecessor's
             # outstanding write-backs are gone with it — unwedge now
             # instead of waiting out the silence timeout
             print(f"{self.identity}: learner epoch -> "
-                  f"{self.core.learner_epoch}, forgave {forgiven} "
-                  f"outstanding write-back(s)", flush=True)
-            self._last_wb = time.monotonic()
+                  f"{core.learner_epoch} (tenant {tenant}), forgave "
+                  f"{forgiven} outstanding write-back(s)", flush=True)
+            self._last_wb[tenant] = time.monotonic()
             self._drain_inbox()
-        batch = self.core.next_batch()
+        batch = core.next_batch()
         if batch is None:
-            reply = ("dry", {"ingested": self.core.ingested,
-                             "warm": self.core.warm,
-                             "stale_wb": self.core.stale_wb,
-                             "restored": self.core.restored})
+            reply = ("dry", {"ingested": core.ingested,
+                             "warm": core.warm,
+                             "stale_wb": core.stale_wb,
+                             "restored": core.restored})
         else:
             obs_spans.stamp(batch, "batch_send")
             self.batches_served += 1
@@ -229,12 +312,17 @@ class ReplayShardServer:
             return
         self.sock.send_multipart([ident, wire.dumps(reply)])
 
-    def _handle_prio(self, seq: int, idx, prios, epoch: int = 0) -> None:
-        stale_before = self.core.stale_wb
-        self.core.write_back(int(seq), idx, prios, epoch=int(epoch))
-        if self.core.stale_wb > stale_before:
+    def _handle_prio(self, seq: int, idx, prios, epoch: int = 0,
+                     tenant: str = tenancy_ns.DEFAULT_TENANT) -> None:
+        core = self.cores.get(tenant)
+        if core is None:
+            self.unknown_tenant += 1
+            return
+        stale_before = core.stale_wb
+        core.write_back(int(seq), idx, prios, epoch=int(epoch))
+        if core.stale_wb > stale_before:
             return      # a dead learner's ghost is not liveness
-        self._last_wb = time.monotonic()
+        self._last_wb[tenant] = time.monotonic()
         self._drain_inbox()
 
     # -- lifecycle -----------------------------------------------------------
@@ -245,17 +333,20 @@ class ReplayShardServer:
             hb = self._hb.maybe_beat(0)
             if hb is not None:
                 self._hb_sender.send_stat(hb)
-        if (self.core.outstanding() > 0
-                and time.monotonic() - self._last_wb
-                > self.comms.dead_after_s):
-            # the learner died between pull and write-back: forgive so
-            # the strict gate (and the actor fleet behind it) unwedges
-            n = self.core.forgive_outstanding()
-            self._last_wb = time.monotonic()
-            print(f"{self.identity}: forgave {n} outstanding "
-                  f"write-back(s) after {self.comms.dead_after_s:.0f}s "
-                  f"of learner silence", flush=True)
-            self._drain_inbox()
+        for tenant, core in list(self.cores.items()):
+            # per-tenant write-back liveness: each tenant's learner
+            # lives and dies on its own — one tenant's death must only
+            # ever unwedge (never wedge) another's partition
+            if (core.outstanding() > 0
+                    and time.monotonic() - self._last_wb[tenant]
+                    > self.comms.dead_after_s):
+                n = core.forgive_outstanding()
+                self._last_wb[tenant] = time.monotonic()
+                print(f"{self.identity}: forgave {n} outstanding "
+                      f"write-back(s) (tenant {tenant}) after "
+                      f"{self.comms.dead_after_s:.0f}s of learner "
+                      f"silence", flush=True)
+                self._drain_inbox()
         self._maybe_snapshot()
         if not self.sock.poll(timeout_ms, self._zmq.POLLIN):
             return False
@@ -269,14 +360,30 @@ class ReplayShardServer:
         if kind == "chunk":
             self._handle_chunk(ident, msg[1])
         elif kind == "pull":
+            # legacy ("pull",) / ("pull", epoch) = the default tenant —
+            # a pre-tenancy learner keeps working unmodified; tenant
+            # learners append their name as the third element
             self._handle_pull(ident,
-                              int(msg[1]) if len(msg) > 1 else 0)
+                              int(msg[1]) if len(msg) > 1 else 0,
+                              str(msg[2]) if len(msg) > 2
+                              else tenancy_ns.DEFAULT_TENANT)
         elif kind == "prio":
             self._handle_prio(msg[1], msg[2], msg[3],
-                              int(msg[4]) if len(msg) > 4 else 0)
+                              int(msg[4]) if len(msg) > 4 else 0,
+                              str(msg[5]) if len(msg) > 5
+                              else tenancy_ns.DEFAULT_TENANT)
         else:
             self.rejected += 1      # well-pickled garbage is still garbage
         return True
+
+    def _gauges(self) -> dict:
+        """Heartbeat gauges: the tenancy scheduler's placement inputs —
+        how many tenant partitions live here, and whether this host is
+        accelerator-backed (the 2311.09445 heterogeneous-placement
+        signal)."""
+        import jax
+        return {"tenants": len(self.cores),
+                "backend_accel": float(jax.default_backend() != "cpu")}
 
     def _maybe_snapshot(self) -> None:
         """Periodic durability tick: persist the shard at most every
@@ -317,7 +424,13 @@ class ReplayShardServer:
                 "chaos_dropped": self.chaos.dropped,
                 "chaos_muted": self.chaos_muted,
                 "snapshots": self.snapshots,
-                "inbox_deferred": len(self._inbox)}
+                "inbox_deferred": len(self._inbox),
+                "unknown_tenant": self.unknown_tenant,
+                # per-tenant partition counters (the default tenant's
+                # duplicate the top-level keys above on purpose: old
+                # readers keep working, new readers get the breakdown)
+                "tenants": {t: c.stats()
+                            for t, c in sorted(self.cores.items())}}
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -349,6 +462,21 @@ def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
     set_process_label(f"replay-{shard_id}")
     get_ring()                      # arm the trace ring's dump triggers
     core = build_shard_core(cfg, shard_id, family=family)
+    # tenant partitions (PR 13): roster tenants' chunks/pulls build
+    # their own partitions lazily; everyone else is refused (counted)
+    roster = tenancy_ns.load_roster()
+
+    def tenant_factory(tenant: str):
+        spec = roster.get(tenant)
+        if spec is None:
+            return None
+        try:
+            return build_shard_core(cfg, shard_id, family=family,
+                                    tenant_spec=spec)
+        except Exception as e:      # a bad roster entry must not kill
+            print(f"replay-{shard_id}: tenant {tenant!r} partition "
+                  f"failed: {type(e).__name__}: {e}", flush=True)
+            return None
     snap_path = None
     if snapshot_dir:
         os.makedirs(snapshot_dir, exist_ok=True)
@@ -365,7 +493,9 @@ def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
                 print(f"replay-{shard_id}: cold start — snapshot "
                       f"unusable ({type(e).__name__}: {e})", flush=True)
     server = ReplayShardServer(cfg.comms, shard_id, core,
-                               snapshot_path=snap_path)
+                               snapshot_path=snap_path,
+                               tenant_factory=(tenant_factory if roster
+                                               else None))
     print(f"replay-{shard_id}: serving on port "
           f"{cfg.comms.replay_port_base + shard_id} "
           f"(capacity={cfg.replay.capacity}, warmup={core.warmup}/shard, "

@@ -76,7 +76,7 @@ class ReplayShardCore:
                  beta: float = 0.4, beta_anneal: int = 500_000,
                  n_shards: int = 1, strict_order: bool = True,
                  presample_depth: int = 2, update_needs_key: bool = False,
-                 example_item=None):
+                 example_item=None, quota: int = 0):
         self.replay = replay
         self.state = replay.init(example_item)
         self.key = key
@@ -88,6 +88,13 @@ class ReplayShardCore:
         self.strict_order = bool(strict_order)
         self.presample_depth = max(1, int(presample_depth))
         self.update_needs_key = bool(update_needs_key)
+        # per-tenant replay quota (PR 13): max RESIDENT transitions this
+        # partition may hold (0 = unlimited — the single-tenant default,
+        # bit-identical behavior).  The server refuses ingest into a
+        # full partition (acked + counted: quota_dropped) so one tenant
+        # can never evict another's experience from the shared shard.
+        self.quota = max(0, int(quota))
+        self.quota_dropped = 0
         # the three programs the fused step decomposes into
         self._add = jax.jit(replay.add, donate_argnums=(0,))
         self._sample = jax.jit(replay.sample, static_argnums=(2,))
@@ -121,6 +128,17 @@ class ReplayShardCore:
     def outstanding(self) -> int:
         """Batches sampled whose priorities have not come back yet."""
         return self.sampled - self.wb_applied
+
+    def resident(self) -> int:
+        """Transitions currently resident (the ring overwrites past
+        capacity, so residency saturates there)."""
+        return min(self.ingested, self.replay.capacity)
+
+    def over_quota(self) -> bool:
+        """True when the partition is at its tenant quota — the server
+        drops (acks + counts) further ingest instead of letting this
+        tenant grow past its admission record."""
+        return self.quota > 0 and self.resident() >= self.quota
 
     def can_ingest(self) -> bool:
         """Strict mode defers ingest behind an outstanding write-back: a
@@ -355,4 +373,6 @@ class ReplayShardCore:
             "restored": self.restored,
             "outbox": len(self._outbox),
             "warm": self.warm,
+            "quota": self.quota,
+            "quota_dropped": self.quota_dropped,
         }

@@ -46,15 +46,19 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "replay",
-                            "infer", "serve-ctl", "status", "loadgen",
-                            "dqn", "aql", "r2d2", "apex", "enjoy"],
+                            "infer", "serve-ctl", "tenant-ctl", "status",
+                            "loadgen", "dqn", "aql", "r2d2", "apex",
+                            "enjoy"],
                    help="socket roles: learner/actor/evaluator/replay "
                         "(one prioritized-replay shard — see "
                         "--replay-shards/--shard-id)/infer (one "
                         "batched-inference shard for --remote-policy "
                         "actors — see --infer-shards/--infer-shard-id)/"
                         "serve-ctl (the serving tier's canary "
-                        "deployment controller, apex_tpu/serving); "
+                        "deployment controller, apex_tpu/serving)/"
+                        "tenant-ctl (the multi-tenant placement "
+                        "controller, apex_tpu/tenancy — admissions, "
+                        "weighted shard bands, evictions); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
                         "loadgen: standalone on-device rollout fleet "
@@ -79,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "per slot); 0 derives the chunk size "
                         "(--send-interval twin) so each dispatch seals "
                         "about one chunk per env slot")
+    # multi-tenant namespace (apex_tpu/tenancy): a whole tenant's roles
+    # opt in with one env export (or this flag twin); everything — wire
+    # identities, chunk ids, param topics, infer requests — qualifies
+    # off it.  Unset = the default tenant, byte-identical single-tenant
+    # behavior.  APEX_TENANTS (JSON roster) configures the SHARED
+    # planes (replay/infer shards, tenant-ctl) with every tenant's
+    # spec; see tenancy/namespace.py.
+    p.add_argument("--tenant", default=e.get("APEX_TENANT", ""),
+                   help="this process's tenant name (env twin "
+                        "APEX_TENANT; empty = the default tenant t0)")
     # env
     p.add_argument("--env-id", default=e.get("APEX_ENV_ID",
                                              "SeaquestNoFrameskip-v4"))
@@ -383,6 +397,11 @@ def main(argv: list[str] | None = None) -> int:
         # the trace ring reads the env at creation; the flag is its twin
         # (exporting here also covers worker processes, which inherit it)
         os.environ["APEX_TRACE_DIR"] = args.trace_dir
+    if args.tenant:
+        # the tenant namespace reads the env at each qualification site
+        # (tenancy/namespace.current_tenant); exporting here covers the
+        # worker processes too, exactly like the trace dir
+        os.environ["APEX_TENANT"] = args.tenant
     cfg = config_from_args(args)
     identity = identity_from_args(args)
 
@@ -476,6 +495,17 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                       version_every=args.serve_version_every,
                       interval_s=args.serve_interval,
                       max_seconds=args.max_seconds)
+    elif args.role == "tenant-ctl":
+        # the multi-tenant placement controller (apex_tpu/tenancy/
+        # scheduler): admits the APEX_TENANTS roster, assigns weighted
+        # replay/infer shard bands, probes each tenant's learner, and
+        # evicts/rebalances on death.  Skips the barrier like the other
+        # controllers.
+        from apex_tpu.runtime.roles import _with_ips
+        from apex_tpu.tenancy.scheduler import run_tenant_ctl
+        cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
+        run_tenant_ctl(cfg, interval_s=args.serve_interval,
+                       max_seconds=args.max_seconds)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the

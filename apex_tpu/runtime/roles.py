@@ -273,7 +273,13 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
             f"(got {family!r}) — aql/r2d2 actors stay on local "
             f"policies (ROADMAP.md)")
     stop_event = stop_event or threading.Event()
-    name = f"actor-{identity.actor_id}"
+    # tenant-qualified wire identity (PR 13): two tenants' actor-0
+    # processes sharing one replay/infer plane must never collide on a
+    # ROUTER identity, and the tenant prefix is what partitions their
+    # chunk ids; the default tenant qualifies to the bare name
+    from apex_tpu.tenancy import namespace as tenancy_ns
+    name = tenancy_ns.qualify(tenancy_ns.current_tenant(),
+                              f"actor-{identity.actor_id}")
     comms = _with_ips(cfg.comms, identity)
     sub = _join_fleet(comms, name, stop_event, barrier_timeout_s)
     eps = actor_epsilons(identity.n_actors, cfg.actor.eps_base,
@@ -373,7 +379,9 @@ def run_loadgen(cfg: ApexConfig, identity: RoleIdentity,
             f"--role loadgen currently serves the dqn family only "
             f"(got {family!r}) — see ROADMAP.md")
     stop_event = stop_event or threading.Event()
-    name = f"loadgen-{identity.actor_id}"
+    from apex_tpu.tenancy import namespace as tenancy_ns
+    name = tenancy_ns.qualify(tenancy_ns.current_tenant(),
+                              f"loadgen-{identity.actor_id}")
     set_process_label(name)
     comms = _with_ips(cfg.comms, identity)
     # engine first: make_jax_env's non-jittable ValueError must fire
@@ -454,7 +462,10 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     from apex_tpu.fleet.chaos import maybe_wrap_sender
     from apex_tpu.fleet.park import ParkController
 
-    name = f"evaluator-{identity.actor_id}-{uuid.uuid4().hex[:6]}"
+    from apex_tpu.tenancy import namespace as tenancy_ns
+    name = tenancy_ns.qualify(
+        tenancy_ns.current_tenant(),
+        f"evaluator-{identity.actor_id}-{uuid.uuid4().hex[:6]}")
     comms = _with_ips(cfg.comms, identity)
     sub = _join_fleet(comms, name, stop_event, barrier_timeout_s)
 

@@ -67,18 +67,32 @@ class ParamPublisher:
     tuple element so parked actors can distinguish a RESTARTED learner
     (epoch changed: the outstanding ack window died with it, reset) from
     a merely STALLED one (same epoch: the acks are still coming).  Zero
-    keeps the legacy 2-tuple wire format."""
+    keeps the legacy 2-tuple wire format.
 
-    def __init__(self, comms: CommsConfig, bind_ip: str = "*"):
+    Tenant topics (PR 13): a non-default-tenant learner prefixes every
+    frame with its :func:`apex_tpu.tenancy.namespace.param_topic` tag so
+    a shared infer shard's per-tenant SUB sockets attribute each publish
+    to the tenant whose learner sent it — and a subscriber pointed at
+    the WRONG tenant's endpoint filters everything instead of silently
+    serving another tenant's params.  ``topic=None`` derives this
+    process's tenant from ``APEX_TENANT`` (the chaos-config env
+    discipline); the default tenant's topic is empty, keeping the wire
+    byte-identical to the pre-tenancy format."""
+
+    def __init__(self, comms: CommsConfig, bind_ip: str = "*",
+                 topic: bytes | None = None):
+        from apex_tpu.tenancy import namespace as tenancy_ns
         self.sock = _ctx().socket(zmq.PUB)
         self.sock.setsockopt(zmq.SNDHWM, comms.param_hwm)
         self.sock.bind(f"tcp://{bind_ip}:{comms.param_port}")
         self.epoch = 0
+        self.topic = (tenancy_ns.param_topic(tenancy_ns.current_tenant())
+                      if topic is None else topic)
 
     def publish(self, version: int, params) -> None:
         msg = ((version, params, self.epoch) if self.epoch
                else (version, params))
-        self.sock.send(pickle.dumps(msg, protocol=5))
+        self.sock.send(self.topic + pickle.dumps(msg, protocol=5))
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -87,12 +101,24 @@ class ParamPublisher:
 class ParamSubscriber:
     """Actor/evaluator-side SUB with CONFLATE=1 — the kernel keeps exactly
     the newest message (``actor.py:40-49`` semantics, no user-space drain
-    loop needed)."""
+    loop needed).
 
-    def __init__(self, comms: CommsConfig, learner_ip: str | None = None):
+    Tenant topics (PR 13): a non-default-tenant subscriber subscribes
+    exactly its tenant's frame prefix and strips it before decoding —
+    zmq's publisher-side prefix filter keeps other tenants' frames off
+    the wire entirely, and CONFLATE then holds the newest frame OF THIS
+    TENANT.  ``topic=None`` derives the tenant from ``APEX_TENANT``;
+    the default tenant subscribes everything (empty prefix), exactly
+    the pre-tenancy socket."""
+
+    def __init__(self, comms: CommsConfig, learner_ip: str | None = None,
+                 topic: bytes | None = None):
+        from apex_tpu.tenancy import namespace as tenancy_ns
+        self.topic = (tenancy_ns.param_topic(tenancy_ns.current_tenant())
+                      if topic is None else topic)
         self.sock = _ctx().socket(zmq.SUB)
         self.sock.setsockopt(zmq.CONFLATE, 1)
-        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self.sock.setsockopt(zmq.SUBSCRIBE, self.topic)
         ip = learner_ip or comms.learner_ip
         self.sock.connect(f"tcp://{ip}:{comms.param_port}")
         self.rejected = 0           # payloads outside the wire allowlist
@@ -105,8 +131,13 @@ class ParamSubscriber:
         (3-tuples) update :attr:`learner_epoch` and still return the
         2-tuple every consumer expects."""
         if self.sock.poll(timeout_ms, zmq.POLLIN):
+            from apex_tpu.tenancy import namespace as tenancy_ns
+            payload = tenancy_ns.strip_topic(self.topic, self.sock.recv())
+            if payload is None:
+                self.rejected += 1      # a frame outside our topic
+                return None
             try:
-                got = wire.restricted_loads(self.sock.recv())
+                got = wire.restricted_loads(payload)
             except wire.WireRejected:
                 self.rejected += 1      # one bad publish costs one poll
                 return None

@@ -41,6 +41,7 @@ ALLOWED_GLOBALS: frozenset[tuple[str, str]] = frozenset({
     ("apex_tpu.actors.pool", "ActorTimingStat"),
     ("apex_tpu.fleet.heartbeat", "Heartbeat"),
     ("apex_tpu.serving.deploy", "ServingStat"),
+    ("apex_tpu.tenancy.scheduler", "TenancyStat"),
     ("numpy", "ndarray"),
     ("numpy", "dtype"),
     ("numpy._core.multiarray", "_reconstruct"),

@@ -49,7 +49,13 @@ def make_infer_client(comms: CommsConfig, identity: str, **kw):
     shard that caused them (a mis-pinned shard shows up in
     ``--role status``, not only in local counters)."""
     from apex_tpu.infer_service.client import InferClient
+    from apex_tpu.tenancy import namespace as tenancy_ns
 
+    # tenant-qualified home-shard hash (PR 13): two tenants' "actor-0"
+    # workers are different identities, so their bands spread
+    # independently; the default tenant qualifies to the bare id and
+    # the pinned single-tenant mapping is untouched
+    identity = tenancy_ns.qualify(tenancy_ns.current_tenant(), identity)
     s = infer_shard(identity, getattr(comms, "infer_shards", 1))
     client = InferClient(comms, identity, port=shard_port(comms, s), **kw)
     client.shard = s

@@ -1,0 +1,19 @@
+"""Multi-tenant fleet-as-a-service (PR 13).
+
+One set of replay shards, infer shards, and supervisors serves MANY
+concurrent experiments.  :mod:`apex_tpu.tenancy.namespace` is the ONE
+module that constructs and parses tenant-qualified identifiers (peer
+identities, chunk ids, param-channel topics — apexlint J017 keeps id
+construction out of everywhere else) and defines :class:`TenantSpec` +
+the ``APEX_TENANTS`` roster; :mod:`apex_tpu.tenancy.scheduler` is the
+placement controller (``--role tenant-ctl``) that admits tenants,
+assigns shard/infer bands by weight, and records the admission/eviction
+timeline in ``fleet_summary.json``.
+"""
+
+from apex_tpu.tenancy.namespace import (DEFAULT_TENANT, TenantSpec,
+                                        current_tenant, load_roster,
+                                        qualify, split, tenant_of)
+
+__all__ = ["DEFAULT_TENANT", "TenantSpec", "current_tenant",
+           "load_roster", "qualify", "split", "tenant_of"]

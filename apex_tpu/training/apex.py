@@ -44,6 +44,7 @@ from apex_tpu.ops.losses import make_optimizer
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.frame_pool import FramePoolReplay
 from apex_tpu.serving.deploy import ServingStat
+from apex_tpu.tenancy.scheduler import TenancyStat
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
                                           Checkpointer)
 from apex_tpu.training.learner import LearnerCore
@@ -159,6 +160,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # canary timeline survives the controller the way the registry
     # survives an actor
     serving_state: dict | None = None
+    # multi-tenant plane (apex_tpu/tenancy): the placement controller's
+    # latest snapshot off the stat channel — folded into
+    # fleet_summary.json ("tenancy" section), the status table's
+    # tenancy lines, and the apex_tenancy_* Prometheus rows
+    tenancy_state: dict | None = None
 
     # -- param plane -------------------------------------------------------
 
@@ -510,6 +516,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     if isinstance(stat, ServingStat):
                         self.serving_state = dict(stat.snapshot)
                         continue
+                    if isinstance(stat, TenancyStat):
+                        self.tenancy_state = dict(stat.snapshot)
+                        continue
                     if isinstance(stat, ActorTimingStat):
                         self.actor_timing[stat.actor_id] = stat
                         self.log.scalars(
@@ -654,6 +663,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 self.serving_state)
             gauges.update(srv_gauges)
             labeled.update(srv_labeled)
+        if self.tenancy_state is not None:
+            # apex_tenancy_* rows: the placement machine — per-tenant
+            # state codes and band sizes next to the serving rows
+            from apex_tpu.tenancy import scheduler as tenancy_sched
+            tn_gauges, tn_labeled = tenancy_sched.prometheus_sections(
+                self.tenancy_state)
+            gauges.update(tn_gauges)
+            labeled.update(tn_labeled)
         return obs_metrics.render(gauges=gauges, counters=counters,
                                   histograms=histograms, labeled=labeled)
 
@@ -756,6 +773,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # asserts its promotion/rollback edges from this persisted
             # section after the fleet is gone
             snap["serving"] = self.serving_state
+        if self.tenancy_state is not None:
+            # the tenancy placement machine (admissions, per-tenant
+            # bands, eviction timeline) — the tenant-smoke drill asserts
+            # both tenants' admissions from this persisted section
+            snap["tenancy"] = self.tenancy_state
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {

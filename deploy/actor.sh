@@ -26,6 +26,7 @@ while [ $idx -lt ${actors_per_node} ]; do
   ACTOR_ID=$(( ${node_id} * ${actors_per_node} + idx ))
   tmux new -s "actor-$ACTOR_ID" -d \
     "JAX_PLATFORMS=cpu APEX_ROLE=actor ACTOR_ID=$ACTOR_ID N_ACTORS=${n_actors} \
+     APEX_TENANT=$${APEX_TENANT:-} \
      N_ENVS_PER_ACTOR=${envs_per_actor} LEARNER_IP=${learner_ip} \
      APEX_REPLAY_SHARDS=${replay_shards} REPLAY_IP=${replay_ip} \
      APEX_REMOTE_POLICY=${remote_policy} APEX_INFER_IP=${infer_ip} \

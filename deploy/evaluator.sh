@@ -16,6 +16,7 @@ cd apex-tpu
 # startup barrier is gone.
 tmux new -s evaluator -d \
   "JAX_PLATFORMS=cpu APEX_LOGDIR=/opt/apex-tpu/runs \
+   APEX_TENANT=$${APEX_TENANT:-} \
    /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
      --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \
      /opt/apex-env/bin/python -m apex_tpu.runtime \

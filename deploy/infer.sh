@@ -29,6 +29,7 @@ INFER_SHARDS="$${APEX_INFER_SHARDS:-1}"
 for s in $(seq 0 $((INFER_SHARDS - 1))); do
   tmux new -s "infer-$s" -d \
     "JAX_PLATFORMS=cpu APEX_ROLE=infer LEARNER_IP=${learner_ip} \
+     APEX_TENANTS='$${APEX_TENANTS:-}' \
      APEX_REMOTE_POLICY=1 APEX_INFER_SHARDS=$INFER_SHARDS \
      /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
        --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \

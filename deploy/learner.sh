@@ -20,6 +20,7 @@ cd apex-tpu
 MESH_DP=0
 [ "${replay_shards}" -gt 0 ] && MESH_DP=1
 tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs \
+  APEX_TENANT=$${APEX_TENANT:-} \
   APEX_REPLAY_SHARDS=${replay_shards} REPLAY_IP=${replay_ip} \
   APEX_MESH_DP=$MESH_DP /opt/apex-env/bin/python -m apex_tpu.runtime \
   --role learner --env-id ${env_id} --n-actors ${n_actors} \

@@ -24,6 +24,7 @@ s=0
 while [ $s -lt ${replay_shards} ]; do
   tmux new -s "replay-$s" -d \
     "JAX_PLATFORMS=cpu APEX_ROLE=replay SHARD_ID=$s \
+     APEX_TENANTS='$${APEX_TENANTS:-}' \
      APEX_REPLAY_SHARDS=${replay_shards} LEARNER_IP=${learner_ip} \
      /opt/apex-env/bin/python -m apex_tpu.fleet.supervise \
        --max-respawns 10 --window 600 --min-uptime 60 --backoff 5 -- \

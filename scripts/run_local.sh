@@ -29,6 +29,19 @@ export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 #     scripts/run_local.sh
 export CHAOS_SEED="${CHAOS_SEED:-}" CHAOS_SPEC="${CHAOS_SPEC:-}"
 
+# Multi-tenancy (apex_tpu/tenancy): export APEX_TENANT=<name> and every
+# role this script launches runs namespaced — qualified wire identities,
+# tenant-prefixed chunk ids, topic-tagged param publishes — so N
+# invocations of this script (one per tenant, distinct APEX_BATCH_PORT/
+# APEX_PARAM_PORT/APEX_BARRIER_PORT/APEX_STATUS_PORT blocks) share ONE
+# externally-launched replay/infer plane.  APEX_LAUNCH_SHARED=0 skips
+# launching the shard/infer/controller processes here (the shared plane
+# already runs elsewhere, carrying the APEX_TENANTS roster);
+# APEX_TENANT_CTL=1 adds the tenancy placement controller
+# (--role tenant-ctl) next to the shared planes.
+export APEX_TENANT="${APEX_TENANT:-}" APEX_TENANTS="${APEX_TENANTS:-}"
+LAUNCH_SHARED="${APEX_LAUNCH_SHARED:-1}"
+
 # Observability (apex_tpu/obs): every role dumps a per-process trace ring
 # (chunk lineage spans, phase/gap events) into APEX_TRACE_DIR — dumped on
 # exit AND flushed periodically, so the actors killed by the EXIT trap
@@ -73,7 +86,7 @@ pids=()
 cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
 trap cleanup EXIT
 
-if [ "$REPLAY_SHARDS" -gt 0 ]; then
+if [ "$REPLAY_SHARDS" -gt 0 ] && [ "$LAUNCH_SHARED" = "1" ]; then
   # shard s binds replay_port_base + s; shards skip the startup barrier
   # (useful the moment the ROUTER binds), so launch them first and the
   # actor fleet's first sealed chunks route straight to them.
@@ -99,7 +112,7 @@ if [ "$REPLAY_SHARDS" -gt 0 ]; then
   done
 fi
 
-if [ "$REMOTE_POLICY" = "1" ]; then
+if [ "$REMOTE_POLICY" = "1" ] && [ "$LAUNCH_SHARED" = "1" ]; then
   # Sharded serving tier (apex_tpu/serving): APEX_INFER_SHARDS=N runs N
   # infer servers, shard s binding infer_port + s; remote-policy workers
   # hash to a home shard by identity.  The servers skip the startup
@@ -134,6 +147,16 @@ if [ "$REMOTE_POLICY" = "1" ]; then
     python -m apex_tpu.runtime --role serve-ctl "${COMMON[@]}" &
     pids+=($!)
   fi
+fi
+
+# Tenancy placement controller (apex_tpu/tenancy/scheduler, --role
+# tenant-ctl): admits the APEX_TENANTS roster, assigns weighted replay/
+# infer shard bands, probes each tenant's learner status port, evicts
+# and rebalances on death; the admission timeline lands in the host
+# learner's fleet_summary.json ("tenancy") and apex_tenancy_* rows.
+if [ "${APEX_TENANT_CTL:-0}" = "1" ] && [ "$LAUNCH_SHARED" = "1" ]; then
+  python -m apex_tpu.runtime --role tenant-ctl "${COMMON[@]}" &
+  pids+=($!)
 fi
 
 # SLO soak traffic (apex_tpu/obs/soak.py): APEX_LOADGEN=N spawns N

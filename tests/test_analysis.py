@@ -1359,6 +1359,67 @@ def test_j016_fires_on_epoch_vs_version_cross_compare():
         """, "J016")
 
 
+# -- J017: tenant-qualified id construction outside tenancy/namespace --------
+
+def test_j017_fires_on_fstring_and_concat_and_join():
+    # the qualified-identity shape: tenant joined to a base with "/"
+    assert fires("""
+        def route(tenant, actor_id):
+            return f"{tenant}/actor-{actor_id}"
+        """, "J017")
+    # the topic shape: tenant between the apxt/ head and the | tail
+    assert fires("""
+        def topic(tenant):
+            return "apxt/" + tenant + "|"
+        """, "J017")
+    # join and format spellings of the same construction
+    assert fires("""
+        def ident(spec_tenant, base):
+            return "/".join([spec_tenant, base])
+        """, "J017")
+    assert fires("""
+        def ident(spec, base):
+            return "{}/{}".format(spec.tenant, base)
+        """, "J017")
+
+
+def test_j017_silent_on_logs_helpers_and_namespace_module():
+    # a log line MENTIONING a tenant is not an id — no separator join
+    assert not fires("""
+        def log(tenant, n):
+            print(f"tenant {tenant} admitted ({n} shards)")
+        """, "J017")
+    # routing through the namespacing helpers is the fix, not a finding
+    assert not fires("""
+        from apex_tpu.tenancy import namespace
+        def route(tenant, base):
+            return namespace.qualify(tenant, base)
+        """, "J017")
+    # a "/" elsewhere in the f-string (not adjacent to the tenant hole)
+    # is not a qualified-id join
+    assert not fires("""
+        def log(tenant, a, b):
+            print(f"shards {a}/{b} assigned to tenant {tenant}")
+        """, "J017")
+    # THE namespacing module is the one construction site
+    src = textwrap.dedent("""
+        def qualify(tenant, base):
+            return f"{tenant}/{base}"
+        """)
+    findings, _ = analyze_source(
+        src, path="apex_tpu/tenancy/namespace.py",
+        rules={"J017": all_rules()["J017"]})
+    assert not findings
+
+
+def test_j017_one_finding_per_concat_chain():
+    src = """
+        def topic(tenant):
+            return "apxt/" + tenant + "|"
+        """
+    assert len(run_rule(src, "J017")) == 1
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

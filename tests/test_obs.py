@@ -595,8 +595,9 @@ def test_prometheus_render_fleet_and_tails():
     assert labeled["fleet_peer_fps"][0][1] == 60.0
     text = obs_metrics.render(gauges=gauges, labeled=labeled)
     assert "apex_fleet_alive 1.0" in text
+    # labels sort alphabetically; tenant (PR 13) rides every peer row
     assert ('apex_fleet_peer_up{identity="actor-0",role="actor",'
-            'state="ALIVE"} 1.0' in text)
+            'state="ALIVE",tenant="t0"} 1.0' in text)
 
     history = {"learner/loss": deque([(0, 1.0), (5, 0.5)]),
                "learner/empty": deque()}

@@ -1,0 +1,773 @@
+"""Multi-tenant plane (apex_tpu/tenancy) — namespace grammar pins,
+default-tenant bit-parity, per-tenant replay partitions + quota
+enforcement over real sockets, per-tenant infer isolation, the placement
+scheduler under fake clocks, and the tenant-labeled operator surfaces.
+
+The load-bearing contract is default-tenant TRANSPARENCY: a fleet that
+never sets APEX_TENANT must produce byte-identical identities, chunk
+ids, param frames, replay state, and infer replies to the pre-tenancy
+code — several tests here pin exactly that, next to the new multi-tenant
+behavior.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.actors.pool import drain_builder_chunks
+from apex_tpu.config import CommsConfig, small_test_config
+from apex_tpu.fleet.chaos import ChaosConfig
+from apex_tpu.fleet.heartbeat import Heartbeat
+from apex_tpu.fleet.registry import FleetRegistry, format_fleet_table
+from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs.slo import resolve_signal
+from apex_tpu.ops.losses import make_optimizer
+from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.replay_service import (ReplayServiceClient, ReplayShardCore,
+                                     ReplayShardServer, chunk_shard)
+from apex_tpu.runtime import transport, wire
+from apex_tpu.tenancy import namespace as ns
+from apex_tpu.tenancy.scheduler import (ACTIVE, EVICTED, PlacementScheduler,
+                                        TenancyStat, assign_bands,
+                                        format_tenancy_lines, place,
+                                        prometheus_sections)
+from apex_tpu.training.state import create_train_state
+
+FRAME_SHAPE = (3,)
+STACK = 2
+K = 8
+BATCH = 16
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chunk_messages(seed: int, n_chunks: int) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    builder = FrameChunkBuilder(2, 0.9, STACK, FRAME_SHAPE,
+                                chunk_transitions=K, frame_margin=4,
+                                frame_dtype=np.uint8)
+    msgs: list[dict] = []
+    while len(msgs) < n_chunks:
+        builder.begin_episode(rng.integers(0, 255, FRAME_SHAPE))
+        ep_len = int(rng.integers(1, 3 * K))
+        for t in range(ep_len):
+            builder.add_step(int(rng.integers(0, 4)), float(rng.normal()),
+                             rng.normal(size=4).astype(np.float32),
+                             rng.integers(0, 255, FRAME_SHAPE),
+                             terminated=t == ep_len - 1, truncated=False)
+        msgs.extend(drain_builder_chunks(builder))
+    return msgs[:n_chunks]
+
+
+def _core(seed=0, quota=0, warmup=10_000) -> ReplayShardCore:
+    replay = FramePoolReplay(capacity=64, frame_shape=FRAME_SHAPE,
+                             frame_stack=STACK, frame_capacity=128,
+                             frame_dtype="uint8")
+    return ReplayShardCore(replay, jax.random.key(seed), batch_size=BATCH,
+                           warmup=warmup, n_shards=1, strict_order=True,
+                           quota=quota)
+
+
+# -- namespace grammar pins --------------------------------------------------
+
+def test_qualify_split_round_trip_and_default_passthrough():
+    # default tenant is TRANSPARENT: bare ids in, bare ids out — the
+    # whole single-tenant fleet's identities/hashes are untouched
+    assert ns.qualify(ns.DEFAULT_TENANT, "actor-3") == "actor-3"
+    assert ns.split("actor-3") == (ns.DEFAULT_TENANT, "actor-3")
+    assert ns.param_topic(ns.DEFAULT_TENANT) == b""
+    # qualified round trip
+    q = ns.qualify("rally", "actor-3")
+    assert q == "rally/actor-3"
+    assert ns.split(q) == ("rally", "actor-3")
+    assert ns.tenant_of(q) == "rally"
+    assert ns.base_of(q) == "actor-3"
+    # chunk ids ride the identity grammar, so tenant_of parses them too
+    cid = ns.chunk_id(q, 17)
+    assert cid == "rally/actor-3:17"
+    assert ns.tenant_of(cid) == "rally"
+    assert ns.chunk_id("actor-0", 5) == "actor-0:5"   # the pinned
+    # pre-tenancy grammar — the crc32 shard-hash population is unchanged
+    assert ns.tenant_of("actor-0:5") == ns.DEFAULT_TENANT
+
+
+def test_tenant_name_validation():
+    for bad in ("", "a/b", "a|b", "a:b"):
+        assert not ns.valid_name(bad)
+        with pytest.raises(ValueError):
+            ns.qualify(bad or "x/y", "actor-0")
+    with pytest.raises(ValueError):
+        ns.TenantSpec(name="ra/lly")
+
+
+def test_param_topic_framing_round_trip():
+    topic = ns.param_topic("rally")
+    assert topic == b"apxt/rally|"
+    payload = pickle.dumps((3, {"w": 1.0}), protocol=5)
+    framed = topic + payload
+    assert ns.strip_topic(topic, framed) == payload
+    # the wrong tenant's frame strips to None (counted + dropped)
+    assert ns.strip_topic(ns.param_topic("catch"), framed) is None
+    # empty topic (default tenant) passes frames through untouched —
+    # EXCEPT the reserved apxt/ head, dropped by grammar so a foreign
+    # tenant's frame never reaches the default tenant's unpickler
+    assert ns.strip_topic(b"", payload) == payload
+    assert ns.strip_topic(b"", framed) is None
+
+
+def test_current_tenant_env_twin():
+    assert ns.current_tenant({}) == ns.DEFAULT_TENANT
+    assert ns.current_tenant({"APEX_TENANT": ""}) == ns.DEFAULT_TENANT
+    assert ns.current_tenant({"APEX_TENANT": "rally"}) == "rally"
+    with pytest.raises(ValueError):
+        ns.current_tenant({"APEX_TENANT": "a/b"})
+
+
+def test_roster_load_and_tenant_comms():
+    import json
+    roster = ns.load_roster({"APEX_TENANTS": json.dumps([
+        {"name": "catch", "env_id": "ApexCatchSmall-v0", "weight": 3.0,
+         "replay_quota": 32, "param_port": 61001, "status_port": 61003},
+        {"name": "rally", "env_id": "ApexRallySmall-v0", "accel": True},
+    ])})
+    assert set(roster) == {"catch", "rally"}
+    assert roster["catch"].replay_quota == 32
+    assert roster["rally"].accel is True
+    assert ns.load_roster({}) == {}
+    with pytest.raises(ValueError):
+        ns.load_roster({"APEX_TENANTS": json.dumps(
+            [{"name": "a"}, {"name": "a"}])})
+    with pytest.raises(ValueError):
+        ns.TenantSpec.from_dict({"name": "a", "nope": 1})
+    comms = CommsConfig()
+    tc = ns.tenant_comms(comms, roster["catch"])
+    assert (tc.param_port, tc.status_port) == (61001, 61003)
+    # 0-ports inherit the shared defaults
+    tc2 = ns.tenant_comms(comms, roster["rally"])
+    assert (tc2.param_port, tc2.status_port) == (comms.param_port,
+                                                 comms.status_port)
+
+
+def test_shard_in_band_stays_in_band():
+    band = [2, 5, 7]
+    picks = {ns.shard_in_band(f"rally/actor-{i}:0", band)
+             for i in range(64)}
+    assert picks <= set(band) and len(picks) > 1
+    assert ns.shard_in_band("x", [4]) == 4
+    with pytest.raises(ValueError):
+        ns.shard_in_band("x", [])
+
+
+# -- param channel topics over real sockets ----------------------------------
+
+def test_tenant_param_channel_isolated_over_sockets():
+    """A rally-tenant publisher tags frames; a rally subscriber gets the
+    params, and a default-tenant subscriber on the SAME endpoint rejects
+    the foreign frames instead of acting on them."""
+    port = _free_port()
+    comms = CommsConfig(param_port=port)
+    pub = transport.ParamPublisher(comms, bind_ip="127.0.0.1",
+                                   topic=ns.param_topic("rally"))
+    sub = transport.ParamSubscriber(comms, topic=ns.param_topic("rally"))
+    default_sub = transport.ParamSubscriber(comms, topic=b"")
+    try:
+        time.sleep(0.3)             # slow-joiner settle
+        got = None
+        deadline = time.monotonic() + 10
+        while got is None and time.monotonic() < deadline:
+            pub.publish(7, {"w": np.float32(1.5)})
+            got = sub.poll(100)
+        assert got is not None
+        version, params = got
+        assert version == 7 and float(params["w"]) == 1.5
+        # the default subscriber saw only undecodable foreign frames
+        assert default_sub.poll(200) is None
+        assert default_sub.rejected > 0
+    finally:
+        pub.close()
+        sub.close()
+        default_sub.close()
+
+
+def test_default_param_wire_byte_identical():
+    """The default tenant's publish frame is the bare pickle — the
+    pre-tenancy wire format, byte for byte."""
+    port = _free_port()
+    comms = CommsConfig(param_port=port)
+    pub = transport.ParamPublisher(comms, bind_ip="127.0.0.1", topic=b"")
+    assert pub.topic == b""
+    import zmq
+    raw = zmq.Context.instance().socket(zmq.SUB)
+    raw.setsockopt(zmq.SUBSCRIBE, b"")
+    raw.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        time.sleep(0.3)
+        frame = None
+        deadline = time.monotonic() + 10
+        while frame is None and time.monotonic() < deadline:
+            pub.publish(3, {"b": 1})
+            if raw.poll(100, zmq.POLLIN):
+                frame = raw.recv()
+        assert frame == pickle.dumps((3, {"b": 1}), protocol=5)
+    finally:
+        pub.close()
+        raw.close(linger=0)
+
+
+# -- replay shard: per-tenant partitions over real sockets -------------------
+
+class _TenantShard:
+    """One ReplayShardServer thread with a tenant factory."""
+
+    def __init__(self, comms, specs: dict, seed=77, warmup=10_000):
+        self.core = _core(seed=seed, warmup=warmup)
+
+        def factory(tenant):
+            spec = specs.get(tenant)
+            if spec is None:
+                return None
+            return _core(seed=seed + 1000, warmup=warmup,
+                         quota=spec.replay_quota)
+
+        self.server = ReplayShardServer(comms, 0, self.core,
+                                        bind_ip="127.0.0.1",
+                                        heartbeat=False,
+                                        tenant_factory=factory)
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.server.run, kwargs={"stop_event": self.stop},
+            daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.stop.set()
+        self.thread.join(timeout=10)
+        self.server.close()
+
+
+def _shard_comms() -> CommsConfig:
+    return CommsConfig(replay_shards=1, replay_port_base=_free_port(),
+                       batch_port=_free_port())
+
+
+def test_replay_partitions_isolate_and_default_stays_bit_identical():
+    """Default and rally chunks land in DISJOINT partitions; the default
+    partition's replay state is bit-identical to a core driven directly
+    with the same messages (tenancy costs the single-tenant path
+    nothing)."""
+    comms = _shard_comms()
+    specs = {"rally": ns.TenantSpec(name="rally")}
+    shard = _TenantShard(comms, specs)
+    sender = transport.ChunkSender(comms, "actor-0",
+                                   port=comms.replay_port_base)
+    rally_ident = ns.qualify("rally", "actor-0")
+    rally_sender = transport.ChunkSender(comms, rally_ident,
+                                         port=comms.replay_port_base)
+    reference = _core(seed=7)       # the direct-drive twin
+    try:
+        default_msgs = _chunk_messages(21, 6)
+        rally_msgs = _chunk_messages(99, 4)
+        for i, msg in enumerate(default_msgs):
+            cid = ns.chunk_id("actor-0", i)
+            assert sender.send_chunk(dict(msg, chunk_id=cid))
+            reference.ingest_msg(dict(msg))
+        for i, msg in enumerate(rally_msgs):
+            assert rally_sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id(rally_ident, i)))
+        want_default = sum(int(m["n_trans"]) for m in default_msgs)
+        want_rally = sum(int(m["n_trans"]) for m in rally_msgs)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (shard.core.ingested == want_default
+                    and shard.server.cores.get("rally") is not None
+                    and shard.server.cores["rally"].ingested
+                    == want_rally):
+                break
+            time.sleep(0.05)
+        assert shard.core.ingested == want_default
+        rally_core = shard.server.cores["rally"]
+        assert rally_core.ingested == want_rally
+        assert shard.server.unknown_tenant == 0
+        # bit-parity: the socket-fed default partition equals the
+        # direct-drive twin, leaf for leaf
+        ref_leaves = jax.tree_util.tree_leaves(reference.state)
+        got_leaves = jax.tree_util.tree_leaves(shard.core.state)
+        assert len(ref_leaves) == len(got_leaves)
+        for a, b in zip(ref_leaves, got_leaves):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # per-tenant stats surfaced
+        stats = shard.server.stats()
+        assert set(stats["tenants"]) == {ns.DEFAULT_TENANT, "rally"}
+        assert stats["tenants"]["rally"]["ingested"] == want_rally
+    finally:
+        sender.close(drain_s=0)
+        rally_sender.close(drain_s=0)
+        shard.close()
+
+
+def test_unadmitted_tenant_refused_but_never_wedged():
+    comms = _shard_comms()
+    shard = _TenantShard(comms, specs={})
+    ghost = ns.qualify("ghost", "actor-0")
+    sender = transport.ChunkSender(comms, ghost,
+                                   port=comms.replay_port_base)
+    try:
+        msgs = _chunk_messages(5, 4)
+        for i, msg in enumerate(msgs):
+            # acked (the sender's window keeps moving) but refused
+            assert sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id(ghost, i)))
+        deadline = time.monotonic() + 10
+        while shard.server.unknown_tenant < 4 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert shard.server.unknown_tenant == 4
+        assert shard.core.ingested == 0
+        assert "ghost" not in shard.server.cores
+    finally:
+        sender.close(drain_s=0)
+        shard.close()
+
+
+def test_quota_enforced_under_full_partition():
+    """A rally partition at its quota refuses further ingest (counted,
+    acked) while the default partition keeps ingesting — one tenant can
+    never squeeze another out of the shared shard."""
+    comms = _shard_comms()
+    specs = {"rally": ns.TenantSpec(name="rally", replay_quota=2 * K)}
+    shard = _TenantShard(comms, specs)
+    rally_ident = ns.qualify("rally", "actor-0")
+    rally_sender = transport.ChunkSender(comms, rally_ident,
+                                         port=comms.replay_port_base)
+    sender = transport.ChunkSender(comms, "actor-0",
+                                   port=comms.replay_port_base)
+    try:
+        rally_msgs = _chunk_messages(31, 6)     # 6*K trans >> quota 2*K
+        # quota enforcement is CHUNK-granular: ingest while resident <
+        # quota, refuse once at/over it — compute the greedy expectation
+        want_rally, rally_dropped = 0, 0
+        for msg in rally_msgs:
+            if want_rally < 2 * K:
+                want_rally += int(msg["n_trans"])
+            else:
+                rally_dropped += 1
+        for i, msg in enumerate(rally_msgs):
+            assert rally_sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id(rally_ident, i)))
+        default_msgs = _chunk_messages(32, 3)
+        for i, msg in enumerate(default_msgs):
+            assert sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id("actor-0", i)))
+        want_default = sum(int(m["n_trans"]) for m in default_msgs)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            rc = shard.server.cores.get("rally")
+            if rc is not None and rc.quota_dropped >= rally_dropped \
+                    and shard.core.ingested == want_default:
+                break
+            time.sleep(0.05)
+        rc = shard.server.cores["rally"]
+        assert rc.quota == 2 * K
+        assert rc.ingested == want_rally    # filled to quota, then shut
+        assert rc.quota_dropped == rally_dropped    # refused, acked
+        assert rc.over_quota()
+        assert shard.core.ingested == want_default      # unaffected
+        assert shard.core.quota_dropped == 0
+    finally:
+        sender.close(drain_s=0)
+        rally_sender.close(drain_s=0)
+        shard.close()
+
+
+def test_tenant_pulls_route_to_own_partition(monkeypatch):
+    """Each tenant's learner pulls ITS partition's batches and its
+    write-backs land on ITS core — pull/prio tuples carry the tenant,
+    and the legacy tuple shapes stay the default tenant's."""
+    comms = _shard_comms()
+    specs = {"rally": ns.TenantSpec(name="rally")}
+    shard = _TenantShard(comms, specs, warmup=1)
+    sender = transport.ChunkSender(comms, "actor-0",
+                                   port=comms.replay_port_base)
+    rally_ident = ns.qualify("rally", "actor-0")
+    rally_sender = transport.ChunkSender(comms, rally_ident,
+                                         port=comms.replay_port_base)
+    client = ReplayServiceClient(comms, identity="learner-a")
+    monkeypatch.setenv("APEX_TENANT", "rally")
+    rally_client = ReplayServiceClient(comms, identity="learner-b")
+    monkeypatch.delenv("APEX_TENANT")
+    assert rally_client.tenant == "rally"
+    try:
+        for i, msg in enumerate(_chunk_messages(41, 3)):
+            assert sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id("actor-0", i)))
+        for i, msg in enumerate(_chunk_messages(42, 3)):
+            assert rally_sender.send_chunk(
+                dict(msg, chunk_id=ns.chunk_id(rally_ident, i)))
+        got = client.poll_batch(timeout=20)
+        rally_got = rally_client.poll_batch(timeout=20)
+        assert got is not None and rally_got is not None
+        assert client.push_priorities(0, got["seq"], got["idx"],
+                                      np.ones(BATCH, np.float32))
+        assert rally_client.push_priorities(
+            0, rally_got["seq"], rally_got["idx"],
+            np.ones(BATCH, np.float32))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if shard.core.wb_applied >= 1 and \
+                    shard.server.cores["rally"].wb_applied >= 1:
+                break
+            time.sleep(0.05)
+        assert shard.core.wb_applied >= 1
+        assert shard.server.cores["rally"].wb_applied >= 1
+    finally:
+        client.close()
+        rally_client.close()
+        sender.close(drain_s=0)
+        rally_sender.close(drain_s=0)
+        shard.close()
+
+
+# -- infer server: per-(tenant, group) isolation ----------------------------
+
+def _infer_model(seed: int):
+    model = DuelingDQN(num_actions=4, obs_is_image=False,
+                       compute_dtype=jnp.float32, scale_uint8=True)
+    ts = create_train_state(model, make_optimizer(), jax.random.key(seed),
+                            np.zeros((1, 3 * STACK), np.uint8))
+    return model, ts.params
+
+
+def _ask(sock, zmq, msg, timeout_s=20.0):
+    sock.send(wire.dumps(("infer", msg)))
+    assert sock.poll(int(timeout_s * 1000), zmq.POLLIN)
+    return wire.restricted_loads(sock.recv())
+
+
+def test_infer_server_never_mixes_tenant_params():
+    """Same obs geometry, two tenants, two param sets: each reply is
+    bit-identical to ITS tenant's policy and the default reply to the
+    default policy — the (tenant, group) coalesce key in action.  A
+    tenant with no params yet gets dry replies."""
+    import zmq
+
+    from apex_tpu.infer_service.service import InferServer
+
+    port = _free_port()
+    comms = CommsConfig(infer_port=port)
+    model, params = _infer_model(0)
+    _, rally_params = _infer_model(1)
+    policy = make_policy_fn(model)
+    server = InferServer(comms, policy, heartbeat=False,
+                         bind_ip="127.0.0.1")
+    server.set_params(3, params, epoch=1)
+    server.add_tenant("rally", policy)
+    server.add_tenant("catch", policy)          # no params yet -> dry
+    server.set_tenant_params("rally", 9, rally_params, epoch=2)
+    stop = threading.Event()
+    t = threading.Thread(target=server.run, kwargs={"stop_event": stop},
+                         daemon=True)
+    t.start()
+
+    obs = np.random.default_rng(5).integers(
+        0, 255, (2, 3 * STACK)).astype(np.uint8)
+    eps = np.zeros(2, np.float32)
+    key = jax.random.key(11)
+    kd = np.asarray(jax.random.key_data(key))
+    jp = jax.jit(policy)
+
+    def expect(p):
+        a, q = jp(p, obs, jnp.float32(0.0),
+                  jax.random.fold_in(jax.random.wrap_key_data(kd), 0))
+        return np.asarray(a), np.asarray(q)
+
+    sock = zmq.Context.instance().socket(zmq.DEALER)
+    sock.setsockopt(zmq.IDENTITY, b"probe")
+    sock.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        base = {"obs": obs, "eps": eps, "key": kd, "group": 0}
+        kind, body = _ask(sock, zmq, dict(base, rid=1))
+        assert kind == "act" and (body["pv"], body["epoch"]) == (3, 1)
+        ea, eq = expect(params)
+        np.testing.assert_array_equal(body["actions"], ea)
+        np.testing.assert_array_equal(body["q"], eq)
+
+        kind, body = _ask(sock, zmq, dict(base, rid=2, tenant="rally"))
+        assert kind == "act" and (body["pv"], body["epoch"]) == (9, 2)
+        ra, rq = expect(rally_params)
+        np.testing.assert_array_equal(body["actions"], ra)
+        np.testing.assert_array_equal(body["q"], rq)
+        assert not np.array_equal(rq, eq), \
+            "two distinct param sets should disagree somewhere"
+
+        kind, body = _ask(sock, zmq, dict(base, rid=3, tenant="catch"))
+        assert kind == "dry" and body["rid"] == 3       # no params yet
+
+        kind, body = _ask(sock, zmq, dict(base, rid=4, tenant="ghost"))
+        assert kind == "dry"                # unadmitted: local fallback
+        assert server.unknown_tenant == 1
+        assert server.gauges()["tenants"] == 3
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+        sock.close(linger=0)
+
+
+def test_infer_client_stamps_tenant(monkeypatch):
+    import zmq
+
+    from apex_tpu.infer_service.client import InferClient
+
+    port = _free_port()
+    comms = CommsConfig(infer_port=port)
+    router = zmq.Context.instance().socket(zmq.ROUTER)
+    router.bind(f"tcp://127.0.0.1:{port}")
+    monkeypatch.setenv("APEX_TENANT", "rally")
+    client = InferClient(comms, ns.qualify("rally", "actor-0"),
+                         wait_s=5.0)
+    monkeypatch.delenv("APEX_TENANT")
+    default_client = InferClient(comms, "actor-1", wait_s=5.0)
+    try:
+        obs = np.zeros((2, 4), np.float32)
+        fb = lambda: (np.zeros(2, np.int64), np.zeros((2, 3), np.float32))
+        client.submit(obs, np.zeros(2, np.float32), jax.random.key(0),
+                      0, fb)
+        _, payload = router.recv_multipart()
+        got = wire.restricted_loads(payload)
+        assert got[1]["tenant"] == "rally"
+        default_client.submit(obs, np.zeros(2, np.float32),
+                              jax.random.key(0), 0, fb)
+        _, payload = router.recv_multipart()
+        got = wire.restricted_loads(payload)
+        assert "tenant" not in got[1]       # pre-tenancy request schema
+    finally:
+        client.close()
+        default_client.close()
+        router.close(linger=0)
+
+
+# -- the placement scheduler -------------------------------------------------
+
+def test_assign_bands_weighted_and_round_robin():
+    assert assign_bands({"a": 1.0, "b": 1.0}, 4) == {"a": [0, 1],
+                                                     "b": [2, 3]}
+    assert assign_bands({"a": 3.0, "b": 1.0}, 4) == {"a": [0, 1, 2],
+                                                     "b": [3]}
+    # every tenant gets a shard even when outnumbered (shared bands)
+    assert assign_bands({"a": 1.0, "b": 1.0, "c": 1.0}, 2) \
+        == {"a": [0], "b": [1], "c": [0]}
+    assert assign_bands({}, 4) == {}
+    # all bands cover the tier exactly once when shards >= tenants
+    bands = assign_bands({"a": 2.0, "b": 1.0, "c": 1.0}, 8)
+    flat = sorted(s for band in bands.values() for s in band)
+    assert flat == list(range(8))
+    assert len(bands["a"]) == 4
+
+
+def test_place_prefers_backend_by_tenant_kind():
+    hosts = {"cpu-box": False, "tpu-box": True}
+    assert place(ns.TenantSpec(name="conv", accel=True), hosts) \
+        == "tpu-box"
+    assert place(ns.TenantSpec(name="toy"), hosts) == "cpu-box"
+    assert place(ns.TenantSpec(name="x"), {}) is None
+
+
+def test_scheduler_admit_evict_rebalance_under_fake_clock():
+    now = [100.0]
+    sched = PlacementScheduler(4, 2, dead_after_s=10.0,
+                               clock=lambda: now[0],
+                               wall=lambda: now[0] + 1e9)
+    catch = ns.TenantSpec(name="catch", weight=1.0)
+    rally = ns.TenantSpec(name="rally", weight=1.0, accel=True)
+    sched.admit(catch)
+    sched.admit(rally)
+    assert sched.admissions == 2
+    assert sched.replay_bands == {"catch": [0, 1], "rally": [2, 3]}
+    assert sched.infer_bands == {"catch": [0], "rally": [1]}
+    # idempotent reconcile: re-admitting an unchanged ACTIVE spec is free
+    sched.admit(catch)
+    assert sched.admissions == 2
+
+    now[0] += 5.0
+    sched.observe("catch", alive=True, severity=0, steps=50)
+    sched.observe("rally", alive=True, severity=2, steps=10)
+    events = sched.tick({"cpu-box": False, "tpu-box": True})
+    assert events == []
+    snap = sched.snapshot()
+    assert snap["tenants"]["rally"]["host"] == "tpu-box"
+    assert snap["tenants"]["catch"]["host"] == "cpu-box"
+    assert snap["tenants"]["rally"]["severity"] == 2
+
+    # rally's learner goes silent past dead_after_s: evicted, and the
+    # survivor's band grows to the whole tier
+    now[0] += 11.0
+    sched.observe("catch", alive=True)
+    events = sched.tick()
+    assert [e["event"] for e in events] == ["EVICTED", "REBALANCED"]
+    assert sched.tenants["rally"].state == EVICTED
+    assert sched.replay_bands == {"catch": [0, 1, 2, 3]}
+    assert sched.evictions == 1
+
+    # the learner answers again: re-admitted, bands rebalance back
+    sched.observe("rally", alive=True)
+    assert sched.tenants["rally"].state == ACTIVE
+    assert sched.replay_bands == {"catch": [0, 1], "rally": [2, 3]}
+    assert sched.admissions == 3
+
+    snap = sched.snapshot()
+    assert snap["kind"] == "apex_tenancy" and snap["version"] == 1
+    assert set(snap) >= {"tenants", "admissions", "evictions",
+                         "rebalances", "timeline", "n_replay_shards",
+                         "n_infer_shards"}
+    assert set(snap["tenants"]["rally"]) >= {
+        "state", "env_id", "weight", "replay_quota", "replay_band",
+        "infer_band", "host", "severity", "silent_s", "evictions"}
+    # the snapshot is wire-safe inside a TenancyStat
+    stat = wire.restricted_loads(wire.dumps(TenancyStat("tenant-ctl",
+                                                        snap)))
+    assert stat.snapshot["evictions"] == 1
+
+
+def test_tenancy_exposition_and_status_lines():
+    now = [0.0]
+    sched = PlacementScheduler(2, 1, clock=lambda: now[0],
+                               wall=lambda: 0.0)
+    sched.admit(ns.TenantSpec(name="catch"))
+    sched.evict("catch", "drill")
+    snap = sched.snapshot()
+    gauges, labeled = prometheus_sections(snap)
+    assert gauges["tenancy_tenants"] == 1
+    assert gauges["tenancy_evictions"] == 1
+    states = dict((row[0]["tenant"], row[1])
+                  for row in labeled["tenancy_tenant_state"])
+    assert states["catch"] == 2             # EVICTED code
+    lines = format_tenancy_lines(snap)
+    assert any("tenant catch: EVICTED" in ln for ln in lines)
+    assert any("EVICTED catch (drill)" in ln for ln in lines)
+    # registered families cover every emitted row name (J015 contract)
+    for fam in list(gauges) + list(labeled):
+        assert fam in obs_metrics.REGISTERED_FAMILIES \
+            or fam in {"tenancy_tenants", "tenancy_admissions",
+                       "tenancy_evictions", "tenancy_rebalances"}
+
+
+# -- tenant-labeled registry / status / SLO surfaces -------------------------
+
+def test_registry_labels_peers_by_tenant_and_table_groups():
+    reg = FleetRegistry(CommsConfig())
+    reg.observe(Heartbeat("actor-0", role="actor", fps=10.0))
+    reg.observe(Heartbeat(ns.qualify("rally", "actor-0"), role="actor",
+                          fps=20.0))
+    reg.observe(Heartbeat(ns.qualify("rally", "evaluator-0-ab"),
+                          role="evaluator"))
+    snap = reg.snapshot()
+    tenants = {p["identity"]: p["tenant"] for p in snap["peers"]}
+    assert tenants == {"actor-0": "t0", "rally/actor-0": "rally",
+                       "rally/evaluator-0-ab": "rally"}
+    table = format_fleet_table(snap)
+    assert "-- tenant t0 --" in table
+    assert "-- tenant rally --" in table
+    # default tenant's block prints first
+    assert table.index("-- tenant t0 --") \
+        < table.index("-- tenant rally --")
+    # tenancy timeline tail rides the status table when present
+    snap["tenancy"] = {"tenants": {}, "admissions": 1, "evictions": 0,
+                       "rebalances": 1,
+                       "timeline": [{"t_s": 1.0, "wall": 0.0,
+                                     "event": "ADMITTED",
+                                     "tenant": "rally",
+                                     "reason": "roster"}]}
+    table = format_fleet_table(snap)
+    assert "tenancy: 0 tenant(s)" in table
+    assert "ADMITTED rally (roster)" in table
+    # single-tenant fleets keep the pre-tenancy table (no group headers)
+    solo = FleetRegistry(CommsConfig())
+    solo.observe(Heartbeat("actor-0", role="actor"))
+    assert "-- tenant" not in format_fleet_table(solo.snapshot())
+
+
+def test_render_fleet_rows_carry_tenant_label():
+    reg = FleetRegistry(CommsConfig())
+    reg.observe(Heartbeat(ns.qualify("rally", "actor-0"), role="actor"))
+    _, labeled = obs_metrics.render_fleet(reg.snapshot())
+    labels, _v = labeled["fleet_peer_up"][0]
+    assert labels["tenant"] == "rally"
+
+
+def test_slo_signal_tenant_suffix_filters_peers():
+    summary = {"peers": [
+        {"identity": "actor-0", "tenant": "t0", "role": "actor",
+         "state": "DEAD", "fps": 0.0, "gauges": {}},
+        {"identity": "rally/actor-0", "tenant": "rally", "role": "actor",
+         "state": "ALIVE", "fps": 30.0,
+         "gauges": {"infer_rt_ms_p99": 12.0}},
+        {"identity": "rally/actor-1", "tenant": "rally", "role": "actor",
+         "state": "ALIVE", "fps": 20.0,
+         "gauges": {"infer_rt_ms_p99": 44.0}},
+    ]}
+    assert resolve_signal(summary, "derived.dead_frac.actor") == 1 / 3
+    assert resolve_signal(summary, "derived.dead_frac.actor@rally") == 0.0
+    assert resolve_signal(summary, "derived.dead_frac.actor@t0") == 1.0
+    assert resolve_signal(summary, "derived.role_fps.actor@rally") == 50.0
+    assert resolve_signal(
+        summary, "gauge:actor:infer_rt_ms_p99:max@rally") == 44.0
+    assert resolve_signal(
+        summary, "derived.dead_frac.actor@ghost") is None
+
+
+# -- chaos: tenant-scoped targeting ------------------------------------------
+
+def test_chaos_tenant_scoped_blast_radius():
+    spec = {"tenant": "rally", "kill": {"actor-0": 5},
+            "mute": ["replay-0"], "epoch_skew": {"learner": -1},
+            "drop_frac": 0.5,
+            "score_bias": {"evaluator": {"after_s": 1, "delta": -9.0}}}
+    chaos = ChaosConfig(7, spec)
+    hit = chaos.plan_for(ns.qualify("rally", "actor-0"))
+    assert hit.kill_at == 5 and hit.drop_frac == 0.5
+    assert chaos.plan_for(ns.qualify("rally", "replay-0")).mute_replies
+    assert chaos.plan_for(
+        ns.qualify("rally", "learner")).epoch_skew == -1
+    sb = chaos.plan_for(ns.qualify("rally", "evaluator-0-ab12"))
+    assert sb.score_bias_delta == -9.0
+    # zero blast radius into other tenants AND the default tenant
+    for other in (ns.qualify("catch", "actor-0"), "actor-0",
+                  "replay-0", "evaluator-0-ab12"):
+        plan = chaos.plan_for(other)
+        assert plan.kill_at is None and plan.drop_frac == 0.0
+        assert not plan.mute_replies and plan.epoch_skew == 0
+        assert plan.score_bias_after_s is None
+    # without the tenant field, full-identity keys still target exactly
+    scoped = ChaosConfig(7, {"kill": {"rally/actor-0": 3}})
+    assert scoped.plan_for("rally/actor-0").kill_at == 3
+    assert scoped.plan_for("actor-0").kill_at is None
+
+
+# -- CLI twin ---------------------------------------------------------------
+
+def test_cli_tenant_flag_env_twin(monkeypatch):
+    from apex_tpu.runtime.cli import build_parser
+    monkeypatch.setenv("APEX_TENANT", "rally")
+    args = build_parser().parse_args([])
+    assert args.tenant == "rally"
+    monkeypatch.delenv("APEX_TENANT")
+    args = build_parser().parse_args(["--tenant", "catch"])
+    assert args.tenant == "catch"
+    assert "tenant-ctl" in build_parser().parse_args(
+        ["--role", "tenant-ctl"]).role
