@@ -1054,3 +1054,81 @@ class CrossTenantId(Rule):
                     "construction site; use apex_tpu.tenancy.namespace "
                     "(qualify/chunk_id/param_topic)"))
         return out
+
+
+# -- J018 -------------------------------------------------------------------
+
+
+@register
+class QuotaAccounting(Rule):
+    id = "J018"
+    name = "quota-accounting"
+    description = ("a replay residency count computed by hand — "
+                   "min(<ingested>, <capacity>) — or an ordering "
+                   "comparison between an ingested count and a quota "
+                   "bound, outside the shard core (apex_tpu/"
+                   "replay_service/shard.py): residency SATURATES at "
+                   "ring capacity (the ring overwrites past it), so a "
+                   "scattered raw count is how a quota check keeps "
+                   "refusing a partition whose ring has long since "
+                   "wrapped — cumulative ingest grows forever while "
+                   "real residency stopped at capacity.  Route the "
+                   "count through ReplayShardCore.resident()/"
+                   "over_quota()")
+
+    #: THE accounting module: the one place residency math may live
+    _EXEMPT = ("apex_tpu/replay_service/shard.py",
+               "replay_service/shard.py")
+    #: the cumulative-ingest spelling family (shard/partition counters)
+    _INGESTED = frozenset({"ingested"})
+    #: ring-capacity spellings (FramePoolReplay and its frame pool)
+    _CAPACITY = frozenset({"capacity", "f_capacity", "frame_capacity"})
+    #: admission-bound spellings (TenantSpec.replay_quota, core.quota)
+    _QUOTA = frozenset({"quota", "replay_quota"})
+
+    @staticmethod
+    def _named(node: ast.AST, names: frozenset) -> bool:
+        """A bare name or attribute tail in the spelling family —
+        ``core.ingested``, ``self.replay.capacity``, ``quota``.  Calls
+        (``core.resident()``) are NOT named values: routing through the
+        accessor is the fix, not a finding."""
+        if isinstance(node, ast.Attribute):
+            return node.attr in names
+        if isinstance(node, ast.Name):
+            return node.id in names
+        return False
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        import os as _os
+        path = ctx.path.replace(_os.sep, "/")
+        if path.endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "min" and len(node.args) >= 2:
+                if any(self._named(a, self._INGESTED)
+                       for a in node.args) \
+                        and any(self._named(a, self._CAPACITY)
+                                for a in node.args):
+                    out.append(ctx.finding(
+                        self, node,
+                        "hand-rolled residency count "
+                        "(min(ingested, capacity)) outside the shard "
+                        "core — use ReplayShardCore.resident()"))
+            elif isinstance(node, ast.Compare) \
+                    and any(isinstance(op, (ast.Lt, ast.LtE, ast.Gt,
+                                            ast.GtE))
+                            for op in node.ops):
+                comparands = (node.left, *node.comparators)
+                if any(self._named(c, self._INGESTED)
+                       for c in comparands) \
+                        and any(self._named(c, self._QUOTA)
+                                for c in comparands):
+                    out.append(ctx.finding(
+                        self, node,
+                        "quota judged against raw cumulative ingest — "
+                        "residency saturates at ring capacity; use "
+                        "ReplayShardCore.resident()/over_quota()"))
+        return out

@@ -329,6 +329,14 @@ def format_fleet_table(snapshot: dict) -> str:
     if tenancy:
         from apex_tpu.tenancy.scheduler import format_tenancy_lines
         lines.extend(format_tenancy_lines(tenancy))
+    # population plane (apex_tpu/population): per-lineage score/
+    # generation/survival and the exploit/explore timeline tail — the
+    # operator table answers "who is winning the ladder and who copied
+    # whom" directly
+    population = snapshot.get("population")
+    if population:
+        from apex_tpu.population.controller import format_population_lines
+        lines.extend(format_population_lines(population))
     return "\n".join(lines)
 
 
@@ -339,22 +347,31 @@ class FleetStatusServer:
     single-threaded, and a status query can never block the data plane.
     zmq imports lazily so in-host trainers work without the comms extra.
 
-    Two request kinds on the one socket: any frame returns the pickled
-    registry snapshot (``--role status``); the frame ``b"metrics"``
-    returns Prometheus text exposition from ``metrics_fn`` (the
-    trainer's live scalars/rates/latency histograms —
-    :mod:`apex_tpu.obs.metrics`), so the fleet is pollable by standard
-    tooling.  Without a ``metrics_fn`` the metrics request degrades to a
-    fleet-only exposition rendered from the registry itself.
+    Three request kinds on the one socket: any plain frame returns the
+    pickled registry snapshot (``--role status``); the frame
+    ``b"metrics"`` returns Prometheus text exposition from
+    ``metrics_fn`` (the trainer's live scalars/rates/latency histograms
+    — :mod:`apex_tpu.obs.metrics`), so the fleet is pollable by
+    standard tooling; a pickled ``("ctl", {...})`` tuple (the PBT
+    controller's exploit/explore commands, :mod:`apex_tpu.population`)
+    is handed to ``ctl_fn`` and acked ``("ctl_ok", info)`` — the hook
+    ENQUEUES only (the trainer thread applies at its next health tick;
+    a command must never touch learner state from this thread).
+    Without a ``metrics_fn`` the metrics request degrades to a
+    fleet-only exposition rendered from the registry itself; without a
+    ``ctl_fn`` ctl frames degrade to status replies (old servers keep
+    answering new controllers harmlessly).
     """
 
     def __init__(self, comms: CommsConfig, registry: FleetRegistry,
-                 bind_ip: str = "*", metrics_fn=None, snapshot_fn=None):
+                 bind_ip: str = "*", metrics_fn=None, snapshot_fn=None,
+                 ctl_fn=None):
         import zmq
 
         self._zmq = zmq
         self.registry = registry
         self.metrics_fn = metrics_fn
+        self.ctl_fn = ctl_fn
         # optional richer status payload (the trainer's fleet_summary —
         # registry snapshot PLUS reaction/replay-service/drain metrics);
         # scale supervisors key off those extras, so the trainer passes it
@@ -386,20 +403,67 @@ class FleetStatusServer:
                 except Exception as e:      # a scrape must never wedge REP
                     text = f"# metrics unavailable: {type(e).__name__}\n"
                 self.sock.send(text.encode("utf-8", errors="replace"))
-            else:                       # any other frame means "status"
-                try:
-                    snap = (self.snapshot_fn()
-                            if self.snapshot_fn is not None
-                            else self.registry.snapshot())
-                except Exception:       # a status query must never wedge
-                    snap = self.registry.snapshot()
-                self.sock.send(wire.dumps(snap))
+            else:
+                reply = None
+                if self.ctl_fn is not None and req != b"status":
+                    try:
+                        msg = wire.restricted_loads(req)
+                    except Exception:
+                        msg = None          # not a ctl frame: status
+                    if (isinstance(msg, tuple) and len(msg) == 2
+                            and msg[0] == "ctl"
+                            and isinstance(msg[1], dict)):
+                        try:
+                            info = self.ctl_fn(dict(msg[1]))
+                        except Exception as e:  # never wedge the REP
+                            info = {"accepted": False,
+                                    "error": type(e).__name__}
+                        reply = wire.dumps(("ctl_ok", info))
+                if reply is None:       # any other frame means "status"
+                    try:
+                        snap = (self.snapshot_fn()
+                                if self.snapshot_fn is not None
+                                else self.registry.snapshot())
+                    except Exception:   # a status query must never wedge
+                        snap = self.registry.snapshot()
+                    reply = wire.dumps(snap)
+                self.sock.send(reply)
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread.ident is not None:
             self._thread.join(timeout=5)
         self.sock.close(linger=0)
+
+
+def ctl_request(comms: CommsConfig, cmd: dict,
+                learner_ip: str | None = None,
+                timeout_s: float = 5.0) -> dict | None:
+    """Client half of the learner ctl surface (the PBT controller's
+    exploit/explore commands): one REQ round-trip carrying
+    ``("ctl", cmd)``; the server's ack info dict, or None when nothing
+    answers (or an old server replied with a status snapshot)."""
+    import zmq
+
+    from apex_tpu.runtime import wire
+
+    sock = zmq.Context.instance().socket(zmq.REQ)
+    ip = learner_ip or comms.learner_ip
+    sock.connect(f"tcp://{ip}:{comms.status_port}")
+    try:
+        sock.send(wire.dumps(("ctl", dict(cmd))))
+        if not sock.poll(int(timeout_s * 1000), zmq.POLLIN):
+            return None
+        try:
+            got = wire.restricted_loads(sock.recv())
+        except wire.WireRejected:
+            return None
+        if isinstance(got, tuple) and len(got) == 2 \
+                and got[0] == "ctl_ok" and isinstance(got[1], dict):
+            return got[1]
+        return None
+    finally:
+        sock.close(linger=0)
 
 
 def status_request(comms: CommsConfig, learner_ip: str | None = None,

@@ -49,6 +49,9 @@ REGISTERED_GAUGES = frozenset({
     # shared-plane beats, the host's accelerator flag (the placement
     # scheduler's 2311.09445 input)
     "tenants", "backend_accel",
+    # population plane (apex_tpu/population): live lineage count on the
+    # pbt-ctl controller's beats
+    "lineages",
 })
 
 #: Declared Prometheus exposition families: the fixed row names the
@@ -79,6 +82,13 @@ REGISTERED_FAMILIES = frozenset({
     "tenancy_tenants", "tenancy_admissions", "tenancy_evictions",
     "tenancy_rebalances", "tenancy_tenant_state",
     "tenancy_tenant_shards",
+    # population rows (population/controller.py prometheus_sections):
+    # the PBT machine — decision counts + per-lineage state/generation/
+    # score
+    "population_lineages", "population_decisions",
+    "population_exploits", "population_explores",
+    "population_lineage_state", "population_lineage_generation",
+    "population_lineage_score",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")

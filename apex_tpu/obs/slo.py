@@ -223,6 +223,46 @@ def default_slos(actor_dead_thresh: float | None = None,
     ]
 
 
+def roster_slos(roster: dict, environ=None) -> list[SloObjective]:
+    """Per-tenant objective SETS declared from the ``APEX_TENANTS`` /
+    ``APEX_POPULATION`` roster (the PR 13 follow-up): for every roster
+    tenant/lineage, a progress-floor objective and an eval-score
+    objective — judged by the CONTROLLER (tenant-ctl/pbt-ctl) off its
+    per-tenant status probes, instead of only the default tenant's
+    engine judging its own fleet.
+
+    Signals walk the controller's probe-derived summary
+    (``{"tenants": {<name>: {"steps_rate": ..., "eval_score": ...}}}``)
+    via the ordinary dotted resolution, so the same
+    :class:`SloEngine` machinery — burn windows, flap damping,
+    timelines — judges them unchanged.  Objective names carry the
+    ``@tenant`` suffix grammar (``steps_floor@rally``) so operators
+    read them next to the existing per-tenant signal paths.
+
+    Env twins: ``APEX_SLO_TENANT_STEPS_RATE`` (default 0.01; ``off``
+    disables) and ``APEX_SLO_TENANT_EVAL_SCORE`` (default observe-only)
+    set the bars for EVERY roster tenant at once.
+    """
+    e = environ if environ is not None else os.environ
+    steps_thr = _thr(e, "APEX_SLO_TENANT_STEPS_RATE", 0.01)
+    score_thr = _thr(e, "APEX_SLO_TENANT_EVAL_SCORE", None)
+    out: list[SloObjective] = []
+    for name in sorted(roster):
+        out.append(SloObjective(
+            f"steps_floor@{name}", f"tenants.{name}.steps_rate",
+            steps_thr, ">=", grace_s=90.0,
+            description=f"tenant {name}: learner progress floor off the "
+                        f"controller's status probes (a stalled lineage "
+                        f"is an outage, not a quiet one)"))
+        out.append(SloObjective(
+            f"eval_score@{name}", f"tenants.{name}.eval_score",
+            score_thr, ">=",
+            description=f"tenant {name}: eval-ladder recent-window mean "
+                        f"(observe-only until an operator sets the "
+                        f"bar)"))
+    return out
+
+
 # -- signal resolution -------------------------------------------------------
 
 

@@ -1,0 +1,18 @@
+"""Population plane: multi-task lineages + the PBT controller.
+
+Each lineage is a TENANT (:class:`~apex_tpu.population.lineage.
+LineageSpec` extends :class:`~apex_tpu.tenancy.namespace.TenantSpec`), so
+the whole multi-tenant substrate — per-tenant replay partitions, quotas,
+infer params, ``@tenant`` SLO signals, chaos scope — carries a
+population of learner lineages with zero new plumbing.  The
+``--role pbt-ctl`` controller (:mod:`apex_tpu.population.controller`)
+polls each lineage's eval-ladder scores and runs truncation-selection
+exploit (checkpoint copy + learner-epoch bump) and perturb/resample
+explore on the hyperparameter vector.
+"""
+
+from apex_tpu.population.lineage import (HPARAM_BANDS, LineageSpec,
+                                         apply_lineage, load_population)
+
+__all__ = ["HPARAM_BANDS", "LineageSpec", "apply_lineage",
+           "load_population"]

@@ -1,0 +1,156 @@
+"""Lineage = tenant + a mutable hyperparameter vector.
+
+The Ape-X epsilon ladder is a degenerate population: one lineage, a
+spectrum of exploration hyperparameters.  The general form adds two
+dimensions — TASKS (the roster assigns env ids per lineage, so one fleet
+mixes Catch/Rally/... and ``make_env``/``make_jax_env`` dispatch per
+lineage) and LINEAGES (each with its own learner fleet whose
+hyperparameters evolve via exploit/explore decisions off eval scores,
+:mod:`apex_tpu.population.controller`).
+
+:class:`LineageSpec` extends :class:`~apex_tpu.tenancy.namespace.
+TenantSpec`, so a lineage IS a tenant: its roles qualify their wire
+identities/chunk ids/param topics off ``APEX_TENANT=<lineage>``, the
+shared replay shards build it a quota-bounded partition, the infer
+shards hold its params, the registry labels its peers, and chaos scopes
+to it — all inherited from the PR 13 namespace grammar, zero new
+plumbing.  The extra fields are the MUTABLE vector (lr, n-step,
+priority exponent/beta, epsilon band — the knobs the PBT controller
+perturbs) plus ``parent``/``generation`` lineage bookkeeping.
+
+Field semantics: a hyperparameter left ``None`` INHERITS the config —
+a roster of one lineage with no overrides configures exactly the plain
+single-tenant run (population-of-1 parity, pinned in
+tests/test_population.py).  ``env_id`` defaults to ``""`` (inherit) for
+the same reason; :meth:`LineageSpec.as_tenant` fills the TenantSpec
+default back in for the shared planes, which size partitions from it.
+
+The ``APEX_POPULATION`` env var carries the lineage roster as JSON
+(list of :class:`LineageSpec` dicts), the ``APEX_TENANTS`` discipline:
+export and go, every shared-plane process loads the same one.
+:func:`apex_tpu.tenancy.namespace.load_roster` folds the population in,
+so lineages are admitted tenants everywhere without a second export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+from apex_tpu.tenancy import namespace
+
+#: the mutable hyperparameter vector and its clamp bands — the space the
+#: controller's perturb/resample explore moves through.  Bands follow
+#: the PBT paper's practice (wide enough to matter, clamped so a run of
+#: x1.2 perturbations cannot walk into a divergent regime); integer
+#: bands (n_steps) perturb by +-1 instead of a factor.
+HPARAM_BANDS: dict[str, tuple[float, float]] = {
+    "lr": (1e-5, 1e-2),
+    "n_steps": (1, 5),
+    "prio_alpha": (0.4, 0.9),
+    "prio_beta": (0.2, 0.8),
+    "eps_base": (0.05, 0.7),
+}
+
+#: vector fields a LIVE learner absorbs mid-run
+#: (:meth:`apex_tpu.training.apex.ConcurrentTrainer.apply_hparams`:
+#: lr rebuilds the optimizer chain, prio_beta re-points the IS-weight
+#: anneal).  The rest shape acting-side programs — n-step chunk
+#: assembly, insert-time priority exponents, the epsilon ladder — and
+#: apply at role (re)spawn via :func:`apply_lineage`.
+LIVE_HPARAMS = ("lr", "prio_beta")
+
+
+@dataclass(frozen=True)
+class LineageSpec(namespace.TenantSpec):
+    """One lineage's admission record: the TenantSpec base (name, env
+    id, family, learner endpoint, replay quota, band weight) plus the
+    mutable hyperparameter vector and lineage ancestry."""
+
+    # env_id redeclared with an INHERIT default ("" = the launching
+    # config's env) so a no-override lineage spec leaves a plain run
+    # untouched; as_tenant() restores the TenantSpec default for the
+    # shared planes, which need a concrete env to size partitions
+    env_id: str = ""
+    lr: float | None = None
+    n_steps: int | None = None
+    prio_alpha: float | None = None
+    prio_beta: float | None = None
+    eps_base: float | None = None
+    parent: str = ""
+    generation: int = 0
+
+    def hparams(self) -> dict:
+        """The mutable vector (None = inherit the config default)."""
+        return {k: getattr(self, k) for k in HPARAM_BANDS}
+
+    def as_tenant(self) -> "LineageSpec":
+        """The admission-plane view: the inherited env id defaulted so
+        partition sizing never sees an empty one.  Still a LineageSpec
+        (a LineageSpec IS a TenantSpec) — the replay shards read the
+        hyperparameter vector too, so a lineage's partition is built
+        with ITS priority exponent/beta, not the shared default's."""
+        if self.env_id:
+            return self
+        return dataclasses.replace(self,
+                                   env_id=namespace.TenantSpec.env_id)
+
+
+def parse_population(raw: str) -> dict[str, LineageSpec]:
+    """``name -> LineageSpec`` from the roster JSON (duplicate lineage
+    names are a config error, the roster discipline)."""
+    specs = [LineageSpec.from_dict(d) for d in json.loads(raw)]
+    out: dict[str, LineageSpec] = {}
+    for spec in specs:
+        if spec.name in out:
+            raise ValueError(
+                f"duplicate lineage {spec.name!r} in population roster")
+        out[spec.name] = spec
+    return out
+
+
+def load_population(environ=None) -> dict[str, LineageSpec]:
+    """The fleet's lineage roster (``APEX_POPULATION``, JSON list of
+    :class:`LineageSpec` dicts); empty when unset.  The default tenant
+    MAY carry an entry — that is how a plain fleet joins a population
+    as lineage zero."""
+    e = os.environ if environ is None else environ
+    raw = str(e.get("APEX_POPULATION", "")).strip()
+    if not raw:
+        return {}
+    return parse_population(raw)
+
+
+def apply_lineage(cfg, spec: LineageSpec):
+    """The lineage's config: env id + hyperparameter vector applied to
+    the role's :class:`~apex_tpu.config.ApexConfig` — after this,
+    ``make_env``/``make_jax_env`` (host and ondevice rollout paths
+    alike), the n-step chunk assembly, the priority exponents, and the
+    epsilon ladder all dispatch off the lineage.  A spec with no
+    overrides returns ``cfg`` UNCHANGED (population-of-1 parity)."""
+    out = cfg
+    if spec.env_id and spec.env_id != cfg.env.env_id:
+        out = out.replace(env=dataclasses.replace(out.env,
+                                                  env_id=spec.env_id))
+    learner = {}
+    if spec.lr is not None:
+        learner["lr"] = float(spec.lr)
+    if spec.n_steps is not None:
+        learner["n_steps"] = int(spec.n_steps)
+    if learner:
+        out = out.replace(learner=dataclasses.replace(out.learner,
+                                                      **learner))
+    replay = {}
+    if spec.prio_alpha is not None:
+        replay["alpha"] = float(spec.prio_alpha)
+    if spec.prio_beta is not None:
+        replay["beta"] = float(spec.prio_beta)
+    if replay:
+        out = out.replace(replay=dataclasses.replace(out.replay,
+                                                     **replay))
+    if spec.eps_base is not None:
+        out = out.replace(actor=dataclasses.replace(
+            out.actor, eps_base=float(spec.eps_base)))
+    return out

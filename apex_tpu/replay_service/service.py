@@ -103,6 +103,13 @@ def build_shard_core(cfg: ApexConfig, shard_id: int, family: str = "dqn",
         cfg = cfg.replace(env=dataclasses.replace(
             cfg.env, env_id=tenant_spec.env_id))
         quota = tenant_spec.replay_quota
+        from apex_tpu.population.lineage import LineageSpec, apply_lineage
+        if isinstance(tenant_spec, LineageSpec):
+            # a population lineage's partition honors ITS replay-shaping
+            # hyperparameters (priority exponent alpha, IS beta) — the
+            # vector the PBT controller mutates, applied where the trees
+            # are built
+            cfg = apply_lineage(cfg, tenant_spec)
     replay = dqn_replay_spec(cfg)
     n = max(1, cfg.comms.replay_shards)
     key = jax.random.key(cfg.env.seed + 977_000 + shard_id)
@@ -159,7 +166,8 @@ class ReplayShardServer:
     def __init__(self, comms: CommsConfig, shard_id: int,
                  core: ReplayShardCore, bind_ip: str = "*",
                  heartbeat=True, snapshot_path: str | None = None,
-                 snapshot_s: float | None = None, tenant_factory=None):
+                 snapshot_s: float | None = None, tenant_factory=None,
+                 snapshot_dir: str | None = None):
         import zmq
 
         from apex_tpu.fleet.chaos import chaos_from_env
@@ -188,13 +196,20 @@ class ReplayShardServer:
         self._last_wb = {tenancy_ns.DEFAULT_TENANT: time.monotonic()}
         # shard durability: periodic whole-state snapshots (taken only at
         # quiescent points so a restore resumes the strict lockstep
-        # bit-exactly); a supervised respawn restores the newest one
+        # bit-exactly); a supervised respawn restores the newest one.
+        # With snapshot_dir set, TENANT partitions snapshot/restore too
+        # (one file per (shard, tenant) — an exploited lineage's replay
+        # state survives its learner's restart cycle, not just the
+        # default tenant's); snapshot_path keeps naming the default
+        # partition's file so pre-tenancy layouts stay readable.
         self.snapshot_path = snapshot_path
+        self.snapshot_dir = snapshot_dir
         self.snapshot_s = (comms.replay_snapshot_s if snapshot_s is None
                            else snapshot_s)
         self._last_snapshot = time.monotonic()
         self.snapshots = 0
         self.snapshot_errors = 0
+        self.tenant_snapshots: dict[str, int] = {}
         chaos = chaos_from_env()
         plan = chaos.plan_for(self.identity) if chaos is not None else None
         self.chaos = _ShardChaos(plan)
@@ -222,17 +237,44 @@ class ReplayShardServer:
 
     def _core_for(self, tenant: str) -> ReplayShardCore | None:
         """This tenant's partition, built lazily from the factory on
-        first sight; None for tenants nobody admitted."""
+        first sight (warm-restored from its own snapshot when one
+        exists); None for tenants nobody admitted."""
         got = self.cores.get(tenant)
         if got is None and self._tenant_factory is not None:
             got = self._tenant_factory(tenant)
             if got is not None:
+                path = self._tenant_snapshot_path(tenant)
+                if path is not None:
+                    import os
+                    if os.path.exists(path):
+                        try:
+                            got.restore_snapshot(path)
+                            print(f"{self.identity}: warm restore "
+                                  f"({got.ingested} transitions, tenant "
+                                  f"{tenant}) from {path}", flush=True)
+                        except Exception as e:
+                            print(f"{self.identity}: tenant {tenant!r} "
+                                  f"cold start — snapshot unusable "
+                                  f"({type(e).__name__}: {e})",
+                                  flush=True)
                 self.cores[tenant] = got
                 self._last_wb[tenant] = time.monotonic()
                 print(f"{self.identity}: tenant partition for "
                       f"{tenant!r} (warmup={got.warmup}, "
                       f"quota={got.quota or 'unlimited'})", flush=True)
         return got
+
+    def _tenant_snapshot_path(self, tenant: str) -> str | None:
+        """Where a tenant partition's snapshot lives: the default
+        partition keeps the pre-tenancy ``snapshot_path`` name; roster
+        tenants get their own per-(shard, tenant) file under
+        ``snapshot_dir`` (None = durability off for that partition)."""
+        if tenancy_ns.is_default(tenant):
+            return self.snapshot_path
+        if not self.snapshot_dir:
+            return None
+        return snapshot_path_for(self.snapshot_dir, self.shard_id,
+                                 tenant=tenant)
 
     def _ingest(self, core: ReplayShardCore, ident: bytes,
                 msg: dict) -> None:
@@ -386,24 +428,32 @@ class ReplayShardServer:
                 "backend_accel": float(jax.default_backend() != "cpu")}
 
     def _maybe_snapshot(self) -> None:
-        """Periodic durability tick: persist the shard at most every
-        ``snapshot_s`` seconds, and only at quiescent points (strict
-        mode) so the on-disk state is the lockstep state a restore
-        resumes.  A failed write is counted, never fatal — durability
-        must not kill a serving shard."""
-        if not self.snapshot_path or self.snapshot_s <= 0:
+        """Periodic durability tick: persist EVERY partition (default +
+        tenant) at most every ``snapshot_s`` seconds, each only at its
+        own quiescent points (strict mode) so the on-disk state is the
+        lockstep state a restore resumes.  A non-quiescent or pathless
+        partition is skipped this round, not blocked on; a failed write
+        is counted, never fatal — durability must not kill a serving
+        shard."""
+        if (not self.snapshot_path and not self.snapshot_dir) \
+                or self.snapshot_s <= 0:
             return
         if time.monotonic() - self._last_snapshot < self.snapshot_s:
             return
-        if not self.core.quiescent():
-            return
-        try:
-            self.core.save_snapshot(self.snapshot_path)
-            self.snapshots += 1
-        except Exception as e:
-            self.snapshot_errors += 1
-            print(f"{self.identity}: snapshot failed: "
-                  f"{type(e).__name__}: {e}", flush=True)
+        for tenant, core in sorted(self.cores.items()):
+            path = self._tenant_snapshot_path(tenant)
+            if path is None or not core.quiescent():
+                continue
+            try:
+                core.save_snapshot(path)
+                self.snapshots += 1
+                if not tenancy_ns.is_default(tenant):
+                    self.tenant_snapshots[tenant] = \
+                        self.tenant_snapshots.get(tenant, 0) + 1
+            except Exception as e:
+                self.snapshot_errors += 1
+                print(f"{self.identity}: snapshot failed (tenant "
+                      f"{tenant}): {type(e).__name__}: {e}", flush=True)
         self._last_snapshot = time.monotonic()
 
     def run(self, stop_event=None, max_seconds: float | None = None) -> dict:
@@ -424,6 +474,7 @@ class ReplayShardServer:
                 "chaos_dropped": self.chaos.dropped,
                 "chaos_muted": self.chaos_muted,
                 "snapshots": self.snapshots,
+                "tenant_snapshots": dict(self.tenant_snapshots),
                 "inbox_deferred": len(self._inbox),
                 "unknown_tenant": self.unknown_tenant,
                 # per-tenant partition counters (the default tenant's
@@ -438,11 +489,18 @@ class ReplayShardServer:
             self._hb_sender.close(drain_s=0.0)
 
 
-def snapshot_path_for(snapshot_dir: str, shard_id: int) -> str:
-    """One canonical snapshot file per shard index — the respawned
-    process finds its predecessor's state without coordination."""
+def snapshot_path_for(snapshot_dir: str, shard_id: int,
+                      tenant: str = tenancy_ns.DEFAULT_TENANT) -> str:
+    """One canonical snapshot file per (shard index, tenant) — the
+    respawned process finds its predecessor's state without
+    coordination.  The default tenant keeps the pre-tenancy name, so
+    existing snapshot layouts restore unchanged."""
     import os
-    return os.path.join(snapshot_dir, f"replay_shard_{shard_id}.msgpack")
+    if tenancy_ns.is_default(tenant):
+        return os.path.join(snapshot_dir,
+                            f"replay_shard_{shard_id}.msgpack")
+    return os.path.join(snapshot_dir,
+                        f"replay_shard_{shard_id}.{tenant}.msgpack")
 
 
 def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
@@ -495,7 +553,8 @@ def run_replay_shard(cfg: ApexConfig, shard_id: int, family: str = "dqn",
     server = ReplayShardServer(cfg.comms, shard_id, core,
                                snapshot_path=snap_path,
                                tenant_factory=(tenant_factory if roster
-                                               else None))
+                                               else None),
+                               snapshot_dir=(snapshot_dir or None))
     print(f"replay-{shard_id}: serving on port "
           f"{cfg.comms.replay_port_base + shard_id} "
           f"(capacity={cfg.replay.capacity}, warmup={core.warmup}/shard, "

@@ -46,9 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native Ape-X/AQL roles (reference arguments.py)")
     p.add_argument("--role", default=ident.role,
                    choices=["learner", "actor", "evaluator", "replay",
-                            "infer", "serve-ctl", "tenant-ctl", "status",
-                            "loadgen", "dqn", "aql", "r2d2", "apex",
-                            "enjoy"],
+                            "infer", "serve-ctl", "tenant-ctl", "pbt-ctl",
+                            "status", "loadgen", "dqn", "aql", "r2d2",
+                            "apex", "enjoy"],
                    help="socket roles: learner/actor/evaluator/replay "
                         "(one prioritized-replay shard — see "
                         "--replay-shards/--shard-id)/infer (one "
@@ -58,7 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "deployment controller, apex_tpu/serving)/"
                         "tenant-ctl (the multi-tenant placement "
                         "controller, apex_tpu/tenancy — admissions, "
-                        "weighted shard bands, evictions); "
+                        "weighted shard bands, evictions)/"
+                        "pbt-ctl (the population-based-training "
+                        "controller, apex_tpu/population — task "
+                        "ladders, exploit/explore over the "
+                        "APEX_POPULATION lineage roster); "
                         "status: print the live fleet table from the "
                         "learner's registry; "
                         "loadgen: standalone on-device rollout fleet "
@@ -237,6 +241,29 @@ def build_parser() -> argparse.ArgumentParser:
                    default=float(e.get("APEX_SERVE_INTERVAL_S") or 5.0),
                    help="serve-ctl: seconds between control rounds "
                         "(learner probe + shard reconcile)")
+    # population plane (apex_tpu/population): pbt-ctl decision knobs.
+    # The lineage roster itself rides APEX_POPULATION (JSON list of
+    # LineageSpec dicts) — env-only like APEX_TENANTS, so every
+    # shared-plane process and the controller load the same one.
+    p.add_argument("--pbt-decide", type=float,
+                   default=float(e.get("APEX_PBT_DECIDE_S") or 30.0),
+                   help="pbt-ctl: seconds between exploit/explore "
+                        "decision rounds (probes keep the "
+                        "--serve-interval cadence)")
+    p.add_argument("--pbt-frac", type=float,
+                   default=float(e.get("APEX_PBT_FRAC") or 0.25),
+                   help="pbt-ctl: truncation fraction — the bottom "
+                        "frac of each task ladder restores the top "
+                        "frac's checkpoint (>= 1 lineage each)")
+    p.add_argument("--pbt-resample", type=float,
+                   default=float(e.get("APEX_PBT_RESAMPLE") or 0.25),
+                   help="pbt-ctl: per-field probability explore "
+                        "resamples from the hyperparameter band "
+                        "instead of perturbing x0.8/x1.2")
+    p.add_argument("--pbt-min-episodes", type=int,
+                   default=int(e.get("APEX_PBT_MIN_EPISODES") or 4),
+                   help="pbt-ctl: eval episodes a lineage needs behind "
+                        "its score before selection judges it")
     # fleet control-plane thresholds (apex_tpu/fleet): heartbeat cadence
     # and the registry/park state-machine windows — env twins so a whole
     # topology (tests, chaos drills) retunes them without flag plumbing
@@ -262,7 +289,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gamma", type=float, default=0.99)
     p.add_argument("--n-steps", type=int, default=3)
     p.add_argument("--target-update-interval", type=int, default=2500)
-    p.add_argument("--save-interval", type=int, default=5000)
+    p.add_argument("--save-interval", type=int,
+                   default=int(e.get("APEX_SAVE_INTERVAL", 5000)),
+                   help="learner steps between checkpoints (env twin "
+                        "APEX_SAVE_INTERVAL — PBT fleets compress it so "
+                        "donor checkpoints exist early)")
     p.add_argument("--mesh-dp", type=int,
                    default=int(e.get("APEX_MESH_DP", 0)),
                    help="learner dp mesh degree: shard the replay across "
@@ -403,6 +434,21 @@ def main(argv: list[str] | None = None) -> int:
         # worker processes too, exactly like the trace dir
         os.environ["APEX_TENANT"] = args.tenant
     cfg = config_from_args(args)
+    # population lineage dispatch (apex_tpu/population): a tenant that
+    # names an APEX_POPULATION roster lineage adopts ITS env id and
+    # hyperparameter vector — make_env/make_jax_env (host and ondevice
+    # rollout paths), the n-step chunk assembly, the priority
+    # exponents, and the epsilon ladder all dispatch per lineage off
+    # the one roster.  No roster entry (or a no-override one) leaves
+    # the config untouched: population-of-1 is a plain run.
+    from apex_tpu.population.lineage import load_population
+    population = load_population()
+    if population:
+        from apex_tpu.population.lineage import apply_lineage
+        from apex_tpu.tenancy import namespace as tenancy_ns
+        lineage = population.get(tenancy_ns.current_tenant())
+        if lineage is not None:
+            cfg = apply_lineage(cfg, lineage)
     identity = identity_from_args(args)
 
     if args.profile_dir and args.role in ("learner", "apex", "dqn", "aql",
@@ -506,6 +552,22 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
         cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
         run_tenant_ctl(cfg, interval_s=args.serve_interval,
                        max_seconds=args.max_seconds)
+    elif args.role == "pbt-ctl":
+        # the population-based-training controller (apex_tpu/population/
+        # controller): probes each APEX_POPULATION lineage's learner,
+        # runs truncation-selection exploit (donor checkpoint copy +
+        # epoch bump via the learner ctl surface) and perturb/resample
+        # explore per task ladder.  Skips the barrier like the other
+        # controllers.
+        from apex_tpu.population.controller import run_pbt_ctl
+        from apex_tpu.runtime.roles import _with_ips
+        cfg = cfg.replace(comms=_with_ips(cfg.comms, identity))
+        run_pbt_ctl(cfg, interval_s=args.serve_interval,
+                    decide_every_s=args.pbt_decide,
+                    frac=args.pbt_frac,
+                    resample_prob=args.pbt_resample,
+                    min_episodes=args.pbt_min_episodes,
+                    max_seconds=args.max_seconds)
     elif args.role == "status":
         # operator surface: one REQ round-trip to the learner's fleet
         # status server — the live membership table, or (--metrics) the

@@ -42,6 +42,7 @@ ALLOWED_GLOBALS: frozenset[tuple[str, str]] = frozenset({
     ("apex_tpu.fleet.heartbeat", "Heartbeat"),
     ("apex_tpu.serving.deploy", "ServingStat"),
     ("apex_tpu.tenancy.scheduler", "TenancyStat"),
+    ("apex_tpu.population.controller", "PopulationStat"),
     ("numpy", "ndarray"),
     ("numpy", "dtype"),
     ("numpy._core.multiarray", "_reconstruct"),

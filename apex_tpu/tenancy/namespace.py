@@ -209,17 +209,30 @@ def load_roster(environ=None) -> dict[str, TenantSpec]:
     :class:`TenantSpec` dicts) as ``name -> spec``; empty when unset.
     The default tenant needs no roster entry — it is the fleet that was
     already there — but MAY carry one (quota/weight for the shared
-    planes)."""
+    planes).
+
+    Population lineages (``APEX_POPULATION``,
+    :mod:`apex_tpu.population.lineage`) fold in as tenants — each
+    lineage IS a tenant, so the shared planes admit a population with
+    one export; an explicit ``APEX_TENANTS`` entry of the same name
+    wins (the operator's word over the controller's)."""
     e = os.environ if environ is None else environ
     raw = str(e.get("APEX_TENANTS", "")).strip()
-    if not raw:
-        return {}
-    specs = [TenantSpec.from_dict(d) for d in json.loads(raw)]
     out: dict[str, TenantSpec] = {}
-    for spec in specs:
-        if spec.name in out:
-            raise ValueError(f"duplicate tenant {spec.name!r} in roster")
-        out[spec.name] = spec
+    if raw:
+        specs = [TenantSpec.from_dict(d) for d in json.loads(raw)]
+        for spec in specs:
+            if spec.name in out:
+                raise ValueError(
+                    f"duplicate tenant {spec.name!r} in roster")
+            out[spec.name] = spec
+    pop_raw = str(e.get("APEX_POPULATION", "")).strip()
+    if pop_raw:
+        # lazy import: population builds ON this module (LineageSpec
+        # extends TenantSpec), so the dependency only runs at call time
+        from apex_tpu.population.lineage import parse_population
+        for name, lineage in parse_population(pop_raw).items():
+            out.setdefault(name, lineage.as_tenant())
     return out
 
 

@@ -339,12 +339,23 @@ class TenantCtl:
     def __init__(self, cfg, interval_s: float = 5.0,
                  roster: dict[str, namespace.TenantSpec] | None = None):
         from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+        from apex_tpu.obs.slo import SloEngine, roster_slos
         from apex_tpu.runtime import transport
 
         self.comms = cfg.comms
         self.interval_s = float(interval_s)
         self.roster = (roster if roster is not None
                        else namespace.load_roster())
+        # per-tenant objective sets from the roster (PR 13 follow-up):
+        # a progress-floor + eval-score objective PER tenant, judged off
+        # this controller's own probe stream — the @tenant suffix only
+        # covered peers the HOST registry sees; these cover every roster
+        # tenant's learner directly
+        self.slo = SloEngine(roster_slos(self.roster)) if self.roster \
+            else None
+        self._probe_marks: dict[str, tuple[float, int]] = {}
+        self._probe_rates: dict[str, float | None] = {}
+        self._probe_scores: dict[str, float | None] = {}
         # eviction needs SEVERAL missed probe rounds, not one slow
         # status reply: the scheduler's clock ticks at interval_s, so a
         # dead_after_s below ~3 ticks would evict on a single learner
@@ -367,6 +378,7 @@ class TenantCtl:
 
     def _probe_tenant(self, spec: namespace.TenantSpec) -> None:
         from apex_tpu.fleet.registry import status_request
+        from apex_tpu.obs.slo import resolve_signal
 
         try:
             snap = status_request(
@@ -376,11 +388,34 @@ class TenantCtl:
             snap = None
         if not snap:
             self.sched.observe(spec.name, alive=False)
+            self._probe_rates[spec.name] = None
+            self._probe_scores[spec.name] = None
             return
         slo = snap.get("slo") or {}
+        steps = snap.get("steps")
         self.sched.observe(spec.name, alive=True,
                            severity=slo.get("severity"),
-                           steps=snap.get("steps"))
+                           steps=steps)
+        # roster-SLO inputs: probe-differenced progress rate + the
+        # tenant's eval-ladder mean off its own registry gauges
+        self._probe_scores[spec.name] = resolve_signal(
+            snap, "gauge:evaluator:eval_score_mean:min")
+        now = time.monotonic()
+        rate = None
+        mark = self._probe_marks.get(spec.name)
+        if steps is not None:
+            if mark is not None and now > mark[0]:
+                rate = max(0.0, (int(steps) - mark[1]) / (now - mark[0]))
+            self._probe_marks[spec.name] = (now, int(steps))
+        self._probe_rates[spec.name] = rate
+
+    def _slo_summary(self) -> dict:
+        """The probe-derived signal space the roster objectives walk
+        (:func:`apex_tpu.obs.slo.roster_slos`)."""
+        return {"tenants": {
+            name: {"steps_rate": self._probe_rates.get(name),
+                   "eval_score": self._probe_scores.get(name)}
+            for name in self.roster}}
 
     def _probe_hosts(self) -> dict[str, bool]:
         """Host -> accelerator-backed, from the shared fleet's
@@ -413,9 +448,17 @@ class TenantCtl:
         for e in self.sched.tick(self._probe_hosts()):
             print(f"tenant-ctl: {e['event']} {e['tenant']} "
                   f"({e['reason']})", flush=True)
+        if self.slo is not None:
+            for tr in self.slo.sample(self._slo_summary()):
+                print(f"tenant-ctl: slo {tr['objective']} {tr['from']} "
+                      f"-> {tr['to']} (value={tr['value']})", flush=True)
         self.ticks += 1
-        self.sender.send_stat(TenancyStat("tenant-ctl",
-                                          self.sched.snapshot()))
+        snap = self.sched.snapshot()
+        if self.slo is not None:
+            # per-tenant objective states ride the tenancy section so
+            # fleet_summary.json answers "is each tenant in objective"
+            snap["slo"] = self.slo.snapshot()
+        self.sender.send_stat(TenancyStat("tenant-ctl", snap))
         hb = self.beat.maybe_beat()
         if hb is not None:
             self.sender.send_stat(hb)

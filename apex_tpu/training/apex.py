@@ -43,6 +43,7 @@ from apex_tpu.models.dueling import DuelingDQN, make_policy_fn
 from apex_tpu.ops.losses import make_optimizer
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.population.controller import PopulationStat
 from apex_tpu.serving.deploy import ServingStat
 from apex_tpu.tenancy.scheduler import TenancyStat
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
@@ -165,6 +166,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
     # fleet_summary.json ("tenancy" section), the status table's
     # tenancy lines, and the apex_tenancy_* Prometheus rows
     tenancy_state: dict | None = None
+    # population plane (apex_tpu/population): the PBT controller's
+    # latest snapshot off the stat channel ("population" section /
+    # status lines / apex_population_* rows), plus the learner-side
+    # half — a bounded ctl command queue the status-server thread
+    # enqueues into and the trainer thread drains on its health tick
+    # (exploit = donor-checkpoint weight copy + epoch bump, explore =
+    # live hyperparameter application), with the applied-command
+    # evidence surfaced as metrics["population_ctl"]
+    population_state: dict | None = None
+    _ctl_queue = None
+    _population_ctl: dict | None = None
+    hparams_live: dict | None = None
 
     # -- param plane -------------------------------------------------------
 
@@ -324,9 +337,16 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # surface, never to a dead learner)
             try:
                 from apex_tpu.fleet.registry import FleetStatusServer
+                if self._ctl_queue is None:
+                    # built BEFORE the server thread starts (the enqueue
+                    # hook runs on that thread); bounded so a runaway
+                    # controller can only ever park 8 commands
+                    import queue as queue_lib
+                    self._ctl_queue = queue_lib.Queue(maxsize=8)
                 self._fleet_status = FleetStatusServer(
                     cfg.comms, self.fleet, metrics_fn=self._metrics_text,
-                    snapshot_fn=self.fleet_summary)
+                    snapshot_fn=self.fleet_summary,
+                    ctl_fn=self._enqueue_ctl)
                 self._fleet_status.start()
             except Exception:
                 self._fleet_status = None
@@ -505,6 +525,11 @@ class ConcurrentTrainer(CheckpointableTrainer):
                     # the actor-capacity alert the sample just advanced
                     self._slo_tick(steps)
                     self._react_to_fleet(steps)
+                    # PBT ctl commands drain HERE (trainer thread): the
+                    # status thread only ever enqueued them, so the
+                    # weight copy / optimizer rebuild touch learner
+                    # state from exactly one thread
+                    self._drain_ctl(steps)
                     self._dump_fleet_summary()
                     last_health = now
 
@@ -518,6 +543,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
                         continue
                     if isinstance(stat, TenancyStat):
                         self.tenancy_state = dict(stat.snapshot)
+                        continue
+                    if isinstance(stat, PopulationStat):
+                        self.population_state = dict(stat.snapshot)
                         continue
                     if isinstance(stat, ActorTimingStat):
                         self.actor_timing[stat.actor_id] = stat
@@ -671,6 +699,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 self.tenancy_state)
             gauges.update(tn_gauges)
             labeled.update(tn_labeled)
+        if self.population_state is not None:
+            # apex_population_* rows: the PBT machine — per-lineage
+            # liveness/generation/score next to the tenancy rows
+            from apex_tpu.population import controller as population_ctl
+            pp_gauges, pp_labeled = population_ctl.prometheus_sections(
+                self.population_state)
+            gauges.update(pp_gauges)
+            labeled.update(pp_labeled)
         return obs_metrics.render(gauges=gauges, counters=counters,
                                   histograms=histograms, labeled=labeled)
 
@@ -744,6 +780,18 @@ class ConcurrentTrainer(CheckpointableTrainer):
                                  if plane is not None else None)
         admitted = getattr(self.pool, "rejoin_admitted", None)
         m["barrier_admitted"] = (admitted() if callable(admitted) else 0)
+        # population plane inputs/evidence (apex_tpu/population): the
+        # newest donor-able checkpoint (the PBT controller reads it off
+        # this surface to source exploit copies), the live-applied
+        # hyperparameter vector, and the applied-ctl record the
+        # pbt-smoke drill asserts (exploit count + post-copy epoch)
+        m["checkpoint_latest"] = (self.checkpointer.latest_path()
+                                  if self.checkpointer is not None
+                                  else None)
+        if self.hparams_live:
+            m["hparams_live"] = dict(self.hparams_live)
+        if self._population_ctl is not None:
+            m["population_ctl"] = dict(self._population_ctl)
         withheld = getattr(self.pool, "acks_withheld", None)
         m["acks_withheld"] = (withheld() if callable(withheld) else 0)
         ondevice = getattr(self.pool, "ondevice_counters", None)
@@ -778,6 +826,12 @@ class ConcurrentTrainer(CheckpointableTrainer):
             # bands, eviction timeline) — the tenant-smoke drill asserts
             # both tenants' admissions from this persisted section
             snap["tenancy"] = self.tenancy_state
+        if self.population_state is not None:
+            # the PBT machine (task ladders, per-lineage score/
+            # generation/survival, exploit/explore timeline) — the
+            # pbt-smoke drill asserts its events from this persisted
+            # section after the fleet is gone
+            snap["population"] = self.population_state
         if self.replay_client is not None:
             c = self.replay_client
             snap["metrics"]["replay_service"] = {
@@ -860,6 +914,167 @@ class ConcurrentTrainer(CheckpointableTrainer):
         self.log.scalars({"fleet_dead_actor_frac": frac,
                           "fleet_floor_relaxed":
                               float(self._floor_relaxed)}, steps)
+
+    # -- population ctl (apex_tpu/population) ------------------------------
+
+    def _enqueue_ctl(self, cmd: dict) -> dict:
+        """Status-server-thread half of the ctl surface: enqueue ONLY
+        (the trainer thread applies at its next health tick — learner
+        state is single-threaded by contract)."""
+        import queue as queue_lib
+        q = self._ctl_queue
+        if q is None:
+            return {"accepted": False, "error": "no ctl queue"}
+        try:
+            q.put_nowait(dict(cmd))
+        except queue_lib.Full:
+            return {"accepted": False, "error": "ctl queue full"}
+        return {"accepted": True, "pending": q.qsize()}
+
+    def _drain_ctl(self, steps: int) -> None:
+        """Trainer-thread half: apply every parked command."""
+        import queue as queue_lib
+        q = self._ctl_queue
+        if q is None:
+            return
+        while True:
+            try:
+                cmd = q.get_nowait()
+            except queue_lib.Empty:
+                return
+            self._apply_ctl(cmd, steps)
+
+    def _apply_ctl(self, cmd: dict, steps: int) -> None:
+        """One PBT command.  ``exploit`` = the donor-checkpoint weight
+        copy (epoch bumped, fleet re-fenced, fresh publish) + the
+        explore half's hyperparameter vector; ``hparams`` = the vector
+        alone.  A failed copy is counted evidence, never a dead
+        learner."""
+        op = str(cmd.get("op") or "")
+        rec = self._population_ctl or {"applied": 0, "exploits": 0,
+                                       "explores": 0, "errors": 0}
+        event: dict = {"op": op, "donor": cmd.get("donor"),
+                       "step": steps}
+        if op == "exploit":
+            path = str(cmd.get("restore_from") or "")
+            import os as os_lib
+            if path and not os_lib.path.exists(path) \
+                    and os_lib.path.isdir(os_lib.path.dirname(path)):
+                # the donor's Checkpointer prunes to its newest few
+                # files, and this command sat in the ctl queue up to
+                # one health tick — a pruned path means a NEWER donor
+                # checkpoint exists in the same directory; copy that
+                # (strictly fresher weights, same lineage)
+                from apex_tpu.training.checkpoint import Checkpointer
+                newer = Checkpointer(
+                    os_lib.path.dirname(path)).latest_path()
+                if newer is not None:
+                    path = newer
+            try:
+                self.restore_weights(path)
+            except Exception as e:
+                rec["errors"] += 1
+                event["error"] = f"{type(e).__name__}: {e}"
+                rec["last"] = event
+                self._population_ctl = rec
+                print(f"population: exploit failed ({event['error']})",
+                      flush=True)
+                return
+            rec["exploits"] += 1
+            event["restored_from"] = path
+            event["learner_epoch"] = self.learner_epoch
+            # re-fence the fleet on the new epoch, then publish the
+            # copied weights promptly — actors/infer shards fence out
+            # the pre-copy life's params, shards its write-backs
+            set_epoch = getattr(self.pool, "set_learner_epoch", None)
+            if set_epoch is not None:
+                set_epoch(self.learner_epoch)
+            if self.replay_client is not None:
+                self.replay_client.learner_epoch = self.learner_epoch
+            applied = self.apply_hparams(cmd.get("hparams") or {})
+            if applied or cmd.get("hparams"):
+                rec["explores"] += 1
+                event["applied"] = applied
+            self._publish()
+        elif op == "hparams":
+            applied = self.apply_hparams(cmd.get("hparams") or {})
+            rec["explores"] += 1
+            event["applied"] = applied
+        else:
+            rec["errors"] += 1
+            event["error"] = f"unknown op {op!r}"
+        rec["applied"] += 1
+        rec["last"] = event
+        self._population_ctl = rec
+        print(f"population: applied {op} "
+              f"(donor={cmd.get('donor') or '-'}, "
+              f"epoch={self.learner_epoch})", flush=True)
+        self.log.scalars({"population_ctl_applied": rec["applied"]},
+                         steps)
+
+    def restore_weights(self, path: str) -> dict:
+        """The PBT exploit weight copy: impose the donor checkpoint's
+        ``train_state`` — params, target, optimizer state — onto THIS
+        live learner (PR 8 snapshot machinery,
+        :func:`apex_tpu.training.checkpoint.load_raw`), leaving replay
+        state, PRNG chain, and progress counters alone, and bump the
+        learner epoch so the pre-copy life's params and write-backs are
+        fenced out exactly as a restart's would be.  Returns the donor
+        checkpoint's metadata."""
+        from flax import serialization
+
+        from apex_tpu.training.checkpoint import load_raw
+        raw, meta = load_raw(path)
+        self.train_state = serialization.from_state_dict(
+            self.train_state, raw["train_state"])
+        self.learner_epoch += 1
+        return meta
+
+    def apply_hparams(self, h: dict) -> dict:
+        """Live half of the lineage hyperparameter vector
+        (:data:`apex_tpu.population.lineage.LIVE_HPARAMS`): ``lr``
+        rebuilds the optimizer chain (same structure, so the running
+        ``opt_state`` carries over; one recompile per explore event),
+        ``prio_beta`` re-points the IS-weight anneal the very next
+        ``_beta()`` call reads.  The acting-side fields (n_steps /
+        prio_alpha / eps_base shape chunk assembly, insert exponents,
+        and the epsilon ladder) are recorded in ``hparams_live`` and
+        apply to the lineage's next worker generation via
+        ``population.lineage.apply_lineage``.  Returns the subset
+        applied live."""
+        import dataclasses as _dc
+        applied: dict = {}
+        lr = h.get("lr")
+        if lr is not None and getattr(self, "n_dp", 1) == 1 \
+                and isinstance(self.core, LearnerCore):
+            lc = self.cfg.learner
+            optimizer = make_optimizer(
+                lr=float(lr), decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
+                centered=lc.rmsprop_centered,
+                max_grad_norm=lc.max_grad_norm,
+                lr_decay_steps=lc.lr_decay_steps,
+                lr_decay_rate=lc.lr_decay_rate)
+            self.core = _dc.replace(self.core, optimizer=optimizer)
+            self._fused = self.core.jit_fused_step()
+            self._train = self.core.jit_train_step()
+            self._ingest = self.core.jit_ingest()
+            if self._multi is not None:
+                self._multi = self.core.jit_fused_multi_step()
+            self._ingest_multi = None       # re-jit lazily off the new core
+            if self._train_batch is not None:
+                self._train_batch = self._make_batch_train()
+            self.cfg = self.cfg.replace(
+                learner=_dc.replace(lc, lr=float(lr)))
+            applied["lr"] = float(lr)
+        beta = h.get("prio_beta")
+        if beta is not None:
+            self.cfg = self.cfg.replace(
+                replay=_dc.replace(self.cfg.replay, beta=float(beta)))
+            applied["prio_beta"] = float(beta)
+        recorded = {k: v for k, v in h.items() if v is not None}
+        if recorded:
+            self.hparams_live = {**(self.hparams_live or {}), **recorded}
+        return applied
 
     def _beta(self, ingested: int | None = None) -> float:
         n = self.ingested if ingested is None else ingested

@@ -42,6 +42,15 @@ export CHAOS_SEED="${CHAOS_SEED:-}" CHAOS_SPEC="${CHAOS_SPEC:-}"
 export APEX_TENANT="${APEX_TENANT:-}" APEX_TENANTS="${APEX_TENANTS:-}"
 LAUNCH_SHARED="${APEX_LAUNCH_SHARED:-1}"
 
+# Population plane (apex_tpu/population): export APEX_POPULATION (JSON
+# lineage roster — each lineage IS a tenant) and run one invocation of
+# this script per lineage (APEX_TENANT=<lineage>, its own port block;
+# the lineage's env id + hyperparameter vector apply from the roster).
+# APEX_PBT_CTL=1 adds the PBT controller (--role pbt-ctl) next to the
+# shared planes: it probes each lineage's status port, and bottom-of-
+# ladder lineages restore the top's checkpoint with a mutated vector.
+export APEX_POPULATION="${APEX_POPULATION:-}"
+
 # Observability (apex_tpu/obs): every role dumps a per-process trace ring
 # (chunk lineage spans, phase/gap events) into APEX_TRACE_DIR — dumped on
 # exit AND flushed periodically, so the actors killed by the EXIT trap
@@ -156,6 +165,17 @@ fi
 # learner's fleet_summary.json ("tenancy") and apex_tenancy_* rows.
 if [ "${APEX_TENANT_CTL:-0}" = "1" ] && [ "$LAUNCH_SHARED" = "1" ]; then
   python -m apex_tpu.runtime --role tenant-ctl "${COMMON[@]}" &
+  pids+=($!)
+fi
+
+# PBT controller (apex_tpu/population/controller, --role pbt-ctl):
+# truncation-selection exploit (donor checkpoint copy + learner-epoch
+# bump through the lineage learners' ctl surfaces) and perturb/resample
+# explore over the APEX_POPULATION roster; the population timeline
+# lands in the host learner's fleet_summary.json ("population") and
+# apex_population_* rows.
+if [ "${APEX_PBT_CTL:-0}" = "1" ] && [ "$LAUNCH_SHARED" = "1" ]; then
+  python -m apex_tpu.runtime --role pbt-ctl "${COMMON[@]}" &
   pids+=($!)
 fi
 

@@ -1420,6 +1420,70 @@ def test_j017_one_finding_per_concat_chain():
     assert len(run_rule(src, "J017")) == 1
 
 
+# -- J018: replay residency/quota accounting outside the shard core ----------
+
+def test_j018_fires_on_handrolled_residency_and_raw_quota_compare():
+    # the resident() shape hand-rolled: residency saturates at ring
+    # capacity, and a scattered min() is how two planes drift
+    assert fires("""
+        def admitted(core):
+            return min(core.ingested, core.capacity)
+        """, "J018")
+    assert fires("""
+        class Gate:
+            def room(self):
+                return min(self.ingested, self.replay.capacity)
+        """, "J018")
+    # quota judged against raw cumulative ingest: wrong once the ring
+    # wraps (ingested grows forever, residency stopped at capacity)
+    assert fires("""
+        class Gate:
+            def over(self):
+                return self.ingested >= self.quota
+        """, "J018")
+    assert fires("""
+        def over(core, spec):
+            return core.ingested > spec.replay_quota
+        """, "J018")
+
+
+def test_j018_silent_on_accessors_literals_and_shard_module():
+    # routing through the core's accessors is the fix, not a finding
+    assert not fires("""
+        def over(core):
+            return core.resident() >= core.quota
+        """, "J018")
+    assert not fires("""
+        def over(core):
+            return core.over_quota()
+        """, "J018")
+    # ordering against literals (test progress asserts) is not
+    # accounting; min() of unrelated names is just math
+    assert not fires("""
+        def check(core):
+            assert core.ingested >= 100
+            return min(1.0, core.ingested / 500)
+        """, "J018")
+    # equality is identity, not accounting
+    assert not fires("""
+        def same(core, spec):
+            return core.quota == spec.replay_quota
+        """, "J018")
+    # THE accounting module is the one place residency math lives
+    src = textwrap.dedent("""
+        class ReplayShardCore:
+            def resident(self):
+                return min(self.ingested, self.replay.capacity)
+
+            def over_quota(self):
+                return self.quota > 0 and self.resident() >= self.quota
+        """)
+    findings, _ = analyze_source(
+        src, path="apex_tpu/replay_service/shard.py",
+        rules={"J018": all_rules()["J018"]})
+    assert not findings
+
+
 # -- engine: parse errors, suppressions, baseline ---------------------------
 
 def test_parse_error_is_a_finding():

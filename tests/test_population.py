@@ -1,0 +1,661 @@
+"""Population plane (apex_tpu/population) — lineage roster + config
+dispatch, controller exploit/explore/mutation under fake clocks,
+population-of-1 parity with a plain run, checkpoint-copy epoch fencing
+into a live learner, the learner ctl surface, tenant-partition snapshots
+on the replay shards, per-tenant roster SLOs, and the CLI twins.
+
+The load-bearing contract is population-of-1 TRANSPARENCY: one lineage
+with no overrides configures exactly the plain single-tenant run
+(identities, config, replay tree state, param wire), and the controller
+never exploits a single-lineage ladder — several tests pin exactly that
+next to the new multi-lineage behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as queue_lib
+import socket
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.actors.pool import drain_builder_chunks
+from apex_tpu.config import ApexConfig, CommsConfig, small_test_config
+from apex_tpu.fleet.registry import (FleetRegistry, FleetStatusServer,
+                                     ctl_request, format_fleet_table,
+                                     status_request)
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs.slo import SloEngine, SloKnobs, resolve_signal, roster_slos
+from apex_tpu.population.controller import (PbtCtl, PopulationController,
+                                            PopulationStat,
+                                            format_population_lines,
+                                            prometheus_sections,
+                                            resolve_vector)
+from apex_tpu.population.lineage import (HPARAM_BANDS, LineageSpec,
+                                         apply_lineage, load_population)
+from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+from apex_tpu.replay.frame_pool import FramePoolReplay
+from apex_tpu.replay_service.service import (ReplayShardServer,
+                                             snapshot_path_for)
+from apex_tpu.replay_service.shard import ReplayShardCore
+from apex_tpu.runtime import wire
+from apex_tpu.tenancy import namespace as ns
+from apex_tpu.training.apex import ApexTrainer
+
+FRAME_SHAPE = (3,)
+STACK = 2
+K = 8
+BATCH = 16
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chunk_messages(seed: int, n_chunks: int) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    builder = FrameChunkBuilder(2, 0.9, STACK, FRAME_SHAPE,
+                                chunk_transitions=K, frame_margin=4,
+                                frame_dtype=np.uint8)
+    msgs: list[dict] = []
+    while len(msgs) < n_chunks:
+        builder.begin_episode(rng.integers(0, 255, FRAME_SHAPE))
+        ep_len = int(rng.integers(1, 3 * K))
+        for t in range(ep_len):
+            builder.add_step(int(rng.integers(0, 4)), float(rng.normal()),
+                             rng.normal(size=4).astype(np.float32),
+                             rng.integers(0, 255, FRAME_SHAPE),
+                             terminated=t == ep_len - 1, truncated=False)
+        msgs.extend(drain_builder_chunks(builder))
+    return msgs[:n_chunks]
+
+
+def _core(seed=0, quota=0, warmup=10_000) -> ReplayShardCore:
+    replay = FramePoolReplay(capacity=64, frame_shape=FRAME_SHAPE,
+                             frame_stack=STACK, frame_capacity=128,
+                             frame_dtype="uint8")
+    return ReplayShardCore(replay, jax.random.key(seed), batch_size=BATCH,
+                           warmup=warmup, n_shards=1, strict_order=True,
+                           quota=quota)
+
+
+def _population() -> dict[str, LineageSpec]:
+    return {
+        "t0": LineageSpec(name="t0", env_id="ApexCatchSmall-v0",
+                          lr=1e-4, prio_beta=0.4, eps_base=0.4),
+        "c1": LineageSpec(name="c1", env_id="ApexCatchSmall-v0",
+                          lr=2e-4, prio_beta=0.5, eps_base=0.3),
+        "r0": LineageSpec(name="r0", env_id="ApexRallySmall-v0"),
+    }
+
+
+# -- roster + namespace merge ------------------------------------------------
+
+def test_lineage_roster_and_namespace_merge():
+    pop_json = json.dumps([
+        {"name": "t0", "env_id": "ApexCatchSmall-v0", "lr": 1e-3,
+         "n_steps": 2},
+        {"name": "r0", "env_id": "ApexRallySmall-v0",
+         "replay_quota": 4096, "parent": "t0", "generation": 3},
+    ])
+    pop = load_population(environ={"APEX_POPULATION": pop_json})
+    assert set(pop) == {"t0", "r0"}
+    assert pop["t0"].lr == 1e-3 and pop["t0"].n_steps == 2
+    assert pop["r0"].generation == 3 and pop["r0"].parent == "t0"
+    assert pop["r0"].replay_quota == 4096      # TenantSpec fields ride
+    assert load_population(environ={}) == {}
+    with pytest.raises(ValueError):
+        load_population(environ={"APEX_POPULATION": json.dumps(
+            [{"name": "a"}, {"name": "a"}])})
+    with pytest.raises(ValueError):
+        LineageSpec.from_dict({"name": "a", "nope": 1})
+
+    # lineages ARE tenants: the shared planes admit them off the one
+    # export, LineageSpec and all (partitions read the vector)
+    roster = ns.load_roster(environ={"APEX_POPULATION": pop_json})
+    assert set(roster) == {"t0", "r0"}
+    assert isinstance(roster["r0"], LineageSpec)
+    # an explicit APEX_TENANTS entry of the same name wins
+    tenants_json = json.dumps([{"name": "r0",
+                                "env_id": "ApexCartPole-v0"}])
+    merged = ns.load_roster(environ={"APEX_TENANTS": tenants_json,
+                                     "APEX_POPULATION": pop_json})
+    assert merged["r0"].env_id == "ApexCartPole-v0"
+    assert not isinstance(merged["r0"], LineageSpec)
+    assert merged["t0"].env_id == "ApexCatchSmall-v0"
+    # an inherited env id is defaulted for the admission plane
+    bare = ns.load_roster(environ={"APEX_POPULATION": json.dumps(
+        [{"name": "x"}])})
+    assert bare["x"].env_id == ns.TenantSpec.env_id
+
+
+def test_apply_lineage_dispatch_and_population_of_one_parity():
+    cfg = ApexConfig()
+    spec = LineageSpec(name="c1", env_id="ApexCatchSmall-v0", lr=1e-3,
+                       n_steps=4, prio_alpha=0.7, prio_beta=0.6,
+                       eps_base=0.2)
+    out = apply_lineage(cfg, spec)
+    assert out.env.env_id == "ApexCatchSmall-v0"
+    assert out.learner.lr == 1e-3 and out.learner.n_steps == 4
+    assert out.replay.alpha == 0.7 and out.replay.beta == 0.6
+    assert out.actor.eps_base == 0.2
+    # population-of-1 parity: a no-override lineage leaves the config
+    # IDENTICAL — and the default tenant's identities stay bare, so a
+    # one-lineage run is byte-for-byte the plain single-tenant run
+    assert apply_lineage(cfg, LineageSpec(name="t0")) == cfg
+    assert ns.qualify(ns.DEFAULT_TENANT, "actor-0") == "actor-0"
+    assert LineageSpec(name="t0").hparams() == {
+        k: None for k in HPARAM_BANDS}
+
+
+# -- mutation ----------------------------------------------------------------
+
+def test_resolve_vector_and_mutation_stays_in_bands():
+    # unset fields resolve to band defaults, deterministically
+    vec = resolve_vector(LineageSpec(name="x"))
+    assert set(vec) == set(HPARAM_BANDS)
+    assert isinstance(vec["n_steps"], int)
+    for name, (lo, hi) in HPARAM_BANDS.items():
+        assert lo <= vec[name] <= hi
+    # explicit fields pass through
+    assert resolve_vector(LineageSpec(name="x", lr=1e-3))["lr"] == 1e-3
+
+    pop = {"a": LineageSpec(name="a", lr=1e-4, n_steps=3,
+                            prio_alpha=0.6, prio_beta=0.4, eps_base=0.4)}
+    c1 = PopulationController(pop, seed=11)
+    c2 = PopulationController(pop, seed=11)
+    base = resolve_vector(pop["a"])
+    m1, notes1 = c1.mutate(dict(base))
+    m2, _ = c2.mutate(dict(base))
+    assert m1 == m2                     # seeded: deterministic
+    assert notes1                       # something moved
+    for name, (lo, hi) in HPARAM_BANDS.items():
+        assert lo <= m1[name] <= hi     # clamped to the band
+    assert isinstance(m1["n_steps"], int)
+    assert abs(m1["n_steps"] - base["n_steps"]) <= 1
+    # resample_prob=1: every field redraws uniformly from its band
+    c3 = PopulationController(pop, seed=5, resample_prob=1.0)
+    m3, notes3 = c3.mutate(dict(base))
+    assert all("resample" in n for n in notes3)
+    for name, (lo, hi) in HPARAM_BANDS.items():
+        assert lo <= m3[name] <= hi
+
+
+# -- the controller under fake clocks ----------------------------------------
+
+def test_controller_exploit_explore_under_fake_clock():
+    now = [1000.0]
+    ctl = PopulationController(_population(), decide_every_s=10.0,
+                               min_episodes=2, seed=3,
+                               clock=lambda: now[0], wall=lambda: 7.0)
+    # task ladders group by env id: 2 Catch lineages share one, Rally
+    # is alone on its own
+    assert ctl.ladders() == {"ApexCatchSmall-v0": ["c1", "t0"],
+                             "ApexRallySmall-v0": ["r0"]}
+    # below min_episodes nothing is judged
+    ctl.observe("t0", alive=True, score=5.0, episodes=1,
+                checkpoint="/ck/t0.msgpack")
+    ctl.observe("c1", alive=True, score=-95.0, episodes=1)
+    ctl.observe("r0", alive=True, score=1.0, episodes=9)
+    assert ctl.tick() == []
+    # donor has a checkpoint, loser is clearly behind -> one exploit
+    ctl.observe("t0", alive=True, score=5.0, episodes=9, steps=120,
+                checkpoint="/ck/t0.msgpack")
+    ctl.observe("c1", alive=True, score=-95.0, episodes=9, steps=100)
+    now[0] += 11.0
+    cmds = ctl.tick()
+    assert len(cmds) == 1
+    lineage, cmd = cmds[0]
+    assert lineage == "c1"
+    assert cmd["op"] == "exploit"
+    assert cmd["restore_from"] == "/ck/t0.msgpack"
+    assert cmd["donor"] == "t0" and cmd["generation"] == 1
+    assert set(cmd["hparams"]) == set(HPARAM_BANDS)
+    events = [(e["event"], e["lineage"]) for e in ctl.timeline]
+    assert ("EXPLOIT", "c1") in events and ("EXPLORE", "c1") in events
+    assert ctl.exploits == 1 and ctl.explores == 1
+    # lineage record advanced; the single-lineage Rally ladder is quiet
+    assert ctl.lineages["c1"].generation == 1
+    assert ctl.lineages["c1"].parent == "t0"
+    assert ctl.lineages["t0"].exploits_donated == 1
+    assert ctl.lineages["r0"].exploits_taken == 0
+    # pacing + cooldown: the next period cannot re-exploit c1
+    assert ctl.tick() == []                     # same period
+    now[0] += 11.0
+    assert ctl.tick() == []                     # cooldown (2 periods)
+    # after the cooldown, a still-losing c1 exploits again
+    now[0] += 21.0
+    ctl.observe("c1", alive=True, score=-95.0, episodes=12)
+    assert len(ctl.tick()) == 1
+    assert ctl.lineages["c1"].generation == 2
+
+
+def test_controller_gates_skips_and_flat_ladders():
+    now = [0.0]
+    pop = {"a": LineageSpec(name="a", env_id="E"),
+           "b": LineageSpec(name="b", env_id="E")}
+    ctl = PopulationController(pop, decide_every_s=5.0, min_episodes=2,
+                               seed=1, clock=lambda: now[0],
+                               wall=lambda: 0.0)
+    # a flat ladder (scores within min_delta) never exploits
+    ctl.observe("a", alive=True, score=1.0, episodes=5, checkpoint="/a")
+    ctl.observe("b", alive=True, score=1.0, episodes=5, checkpoint="/b")
+    assert ctl.tick() == []
+    # a donor without a checkpoint defers (recorded, not silent)
+    now[0] += 6.0
+    ctl2 = PopulationController(pop, decide_every_s=5.0, min_episodes=2,
+                                seed=1, clock=lambda: now[0],
+                                wall=lambda: 0.0)
+    ctl2.observe("a", alive=True, score=9.0, episodes=5)   # no ckpt
+    ctl2.observe("b", alive=True, score=1.0, episodes=5)
+    assert ctl2.tick() == []
+    assert [e["event"] for e in ctl2.timeline] == ["SKIPPED"]
+    # a dead lineage is never judged (and never exploited)
+    ctl2.observe("b", alive=False)
+    now[0] += 6.0
+    assert ctl2.tick() == []
+
+
+def test_population_of_one_never_exploits():
+    now = [0.0]
+    pop = {"solo": LineageSpec(name="solo", env_id="ApexCatchSmall-v0")}
+    ctl = PopulationController(pop, decide_every_s=1.0, min_episodes=1,
+                               seed=0, clock=lambda: now[0],
+                               wall=lambda: 0.0)
+    for _ in range(20):
+        ctl.observe("solo", alive=True, score=3.0, episodes=50,
+                    steps=1000, checkpoint="/ck")
+        now[0] += 2.0
+        assert ctl.tick() == []
+    snap = ctl.snapshot()
+    assert snap["exploits"] == 0 and snap["explores"] == 0
+    assert snap["timeline"] == []
+    assert snap["lineages"]["solo"]["generation"] == 0
+    assert snap["lineages"]["solo"]["exploits_taken"] == 0
+
+
+# -- snapshot schema + exposition + wire -------------------------------------
+
+def test_population_snapshot_schema_exposition_and_wire():
+    now = [0.0]
+    ctl = PopulationController(_population(), decide_every_s=1.0,
+                               min_episodes=1, seed=3,
+                               clock=lambda: now[0], wall=lambda: 2.0)
+    ctl.observe("t0", alive=True, score=4.0, episodes=6,
+                checkpoint="/ck/t0")
+    ctl.observe("c1", alive=True, score=-6.0, episodes=6)
+    now[0] += 2.0
+    assert ctl.tick()
+    snap = ctl.snapshot()
+    # tests pin this schema: the pbt-smoke drill asserts off it
+    assert snap["kind"] == "apex_population" and snap["version"] == 1
+    assert set(snap) >= {"lineages", "decisions", "exploits", "explores",
+                         "timeline", "decide_every_s", "frac"}
+    assert set(snap["lineages"]["c1"]) >= {
+        "task", "alive", "score", "episodes", "steps", "generation",
+        "parent", "exploits_taken", "exploits_donated", "checkpoint",
+        "hparams"}
+    e = snap["timeline"][0]
+    assert set(e) >= {"t_s", "wall", "event", "lineage", "reason"}
+    # wire-safe inside a PopulationStat
+    stat = wire.restricted_loads(wire.dumps(PopulationStat("pbt-ctl",
+                                                           snap)))
+    assert stat.snapshot["exploits"] == 1
+    # exposition rows ride registered families only (J015 contract)
+    gauges, labeled = prometheus_sections(snap)
+    assert gauges["population_lineages"] == 3
+    assert gauges["population_exploits"] == 1
+    for fam in list(gauges) + list(labeled):
+        assert fam in obs_metrics.REGISTERED_FAMILIES, fam
+    gens = dict((row[0]["lineage"], row[1])
+                for row in labeled["population_lineage_generation"])
+    assert gens["c1"] == 1
+    lines = format_population_lines(snap)
+    assert any("lineage c1" in ln and "gen=1" in ln for ln in lines)
+    assert any("EXPLOIT c1" in ln for ln in lines)
+    # the status table renders the section when present
+    reg = FleetRegistry(CommsConfig())
+    table_snap = reg.snapshot()
+    table_snap["population"] = snap
+    table = format_fleet_table(table_snap)
+    assert "population: 3 lineage(s)" in table
+
+
+# -- checkpoint-copy into a live learner + epoch fencing ---------------------
+
+@pytest.fixture(scope="module")
+def _trainers(tmp_path_factory):
+    """Two small live learners (distinct seeds -> distinct params) with
+    dummy pools; A has a checkpoint directory."""
+    class _DummyPool:
+        accepts_device_params = False
+
+        def __init__(self):
+            self.published = []
+            self.epochs = []
+
+        def publish_params(self, version, params):
+            self.published.append(version)
+
+        def set_learner_epoch(self, epoch):
+            self.epochs.append(epoch)
+
+    ck = tmp_path_factory.mktemp("pbt-ck")
+    cfg_a = small_test_config()
+    cfg_b = small_test_config()
+    import dataclasses
+    cfg_b = cfg_b.replace(env=dataclasses.replace(cfg_b.env, seed=777))
+    a = ApexTrainer(cfg_a, pool=_DummyPool(), checkpoint_dir=str(ck))
+    b = ApexTrainer(cfg_b, pool=_DummyPool())
+    return a, b
+
+
+def test_restore_weights_copies_params_and_bumps_epoch(_trainers):
+    a, b = _trainers
+    path = a.save_checkpoint()
+    # distinct seeds -> distinct params before the copy
+    la = jax.tree_util.tree_leaves(a.train_state.params)
+    lb = jax.tree_util.tree_leaves(b.train_state.params)
+    assert any(not np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+    replay_before = b.replay_state
+    key_before = np.asarray(jax.random.key_data(b.key)).copy()
+    steps_before = b.steps_rate.total
+    epoch_before = b.learner_epoch
+    b.restore_weights(path)
+    # the weight copy: params AND target AND optimizer state are the
+    # donor's, bit for bit
+    for x, y in zip(jax.tree_util.tree_leaves(a.train_state.params),
+                    jax.tree_util.tree_leaves(b.train_state.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(
+            jax.tree_util.tree_leaves(a.train_state.target_params),
+            jax.tree_util.tree_leaves(b.train_state.target_params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # ...while replay state, PRNG chain, and progress stay THIS life's
+    assert b.replay_state is replay_before
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(b.key)), key_before)
+    assert b.steps_rate.total == steps_before
+    # the epoch fence bumped: the pre-copy life is a dead predecessor
+    assert b.learner_epoch == epoch_before + 1
+    # a replay shard rejects the pre-copy life's write-backs once it
+    # has seen the post-copy epoch (the PR 8 fence, reused verbatim)
+    core = _core(warmup=1)
+    core.note_epoch(b.learner_epoch)
+    assert not core.write_back(0, np.zeros(1, np.int32),
+                               np.ones(1, np.float32),
+                               epoch=epoch_before)
+    assert core.stale_wb == 1
+
+
+def test_apply_hparams_live_half(_trainers):
+    _, b = _trainers
+    fused_before = b._fused
+    applied = b.apply_hparams({"lr": 1e-3, "prio_beta": 0.7,
+                               "n_steps": 4, "prio_alpha": None})
+    assert applied == {"lr": 1e-3, "prio_beta": 0.7}
+    assert b.cfg.learner.lr == 1e-3
+    assert b.cfg.replay.beta == 0.7
+    assert b._beta(0) == 0.7            # the anneal re-pointed
+    assert b._fused is not fused_before  # optimizer rebuilt + re-jitted
+    assert b.core.optimizer is not None
+    # the acting-side half is recorded for the next worker generation
+    assert b.hparams_live["n_steps"] == 4
+    assert "prio_alpha" not in applied
+
+
+def test_ctl_queue_exploit_applies_and_publishes(_trainers):
+    a, b = _trainers
+    path = a.checkpointer.latest_path()
+    assert path is not None
+    b._ctl_queue = queue_lib.Queue(maxsize=8)
+    info = b._enqueue_ctl({"op": "exploit", "restore_from": path,
+                           "hparams": {"prio_beta": 0.6}, "donor": "t0"})
+    assert info == {"accepted": True, "pending": 1}
+    epoch_before = b.learner_epoch
+    published_before = len(b.pool.published)
+    b._drain_ctl(steps=0)
+    rec = b._population_ctl
+    assert rec["exploits"] == 1 and rec["applied"] == 1
+    assert rec["last"]["op"] == "exploit"
+    assert rec["last"]["learner_epoch"] == epoch_before + 1
+    # the copied weights published promptly under the NEW epoch
+    assert len(b.pool.published) == published_before + 1
+    assert b.pool.epochs[-1] == b.learner_epoch
+    # evidence rides fleet_summary metrics (checkpoint_latest is the
+    # controller's donor-sourcing input; population_ctl the smoke's
+    # applied-copy assert)
+    a.fleet = FleetRegistry(a.cfg.comms)
+    b.fleet = FleetRegistry(b.cfg.comms)
+    assert a.fleet_summary()["metrics"]["checkpoint_latest"] == path
+    mb = b.fleet_summary()["metrics"]
+    assert mb["population_ctl"]["exploits"] == 1
+    assert mb["hparams_live"]["prio_beta"] == 0.6
+    # an unreadable donor path is counted evidence, never a crash
+    b._enqueue_ctl({"op": "exploit", "restore_from": "/nope.msgpack"})
+    b._drain_ctl(steps=0)
+    assert b._population_ctl["errors"] == 1
+    assert b.learner_epoch == epoch_before + 1      # no bump on failure
+
+
+def test_ctl_exploit_pruned_path_falls_back_to_newest(_trainers, tmp_path):
+    """The donor's Checkpointer prunes to its newest files; a command
+    naming a pruned path restores the NEWEST donor checkpoint in the
+    same directory instead of failing (live-rehearsal finding)."""
+    import shutil
+
+    a, b = _trainers
+    src = a.checkpointer.latest_path()
+    donor_dir = tmp_path / "donor"
+    donor_dir.mkdir()
+    shutil.copy(src, donor_dir / "ckpt_9.msgpack")
+    b._ctl_queue = queue_lib.Queue(maxsize=8)
+    b._enqueue_ctl({"op": "exploit", "donor": "t0",
+                    "restore_from": str(donor_dir / "ckpt_1.msgpack")})
+    errors_before = b._population_ctl["errors"]
+    b._drain_ctl(steps=0)
+    assert b._population_ctl["errors"] == errors_before
+    assert b._population_ctl["last"]["restored_from"].endswith(
+        "ckpt_9.msgpack")
+
+
+def test_status_server_ctl_round_trip():
+    comms = CommsConfig(status_port=_free_port())
+    seen = []
+
+    def ctl_fn(cmd):
+        seen.append(cmd)
+        return {"accepted": True, "echo": cmd["op"]}
+
+    server = FleetStatusServer(comms, FleetRegistry(comms),
+                               ctl_fn=ctl_fn)
+    server.start()
+    try:
+        info = ctl_request(comms, {"op": "hparams",
+                                   "hparams": {"lr": 1e-3}},
+                           timeout_s=10.0)
+        assert info == {"accepted": True, "echo": "hparams"}
+        assert seen and seen[0]["hparams"]["lr"] == 1e-3
+        # the plain status request still answers on the same socket
+        snap = status_request(comms, timeout_s=10.0)
+        assert snap is not None and "peers" in snap
+    finally:
+        server.stop()
+    # a ctl-less server (pre-population learner) degrades a ctl frame
+    # to a status reply — the controller reads "no ack", never wedges
+    comms2 = CommsConfig(status_port=_free_port())
+    server2 = FleetStatusServer(comms2, FleetRegistry(comms2))
+    server2.start()
+    try:
+        assert ctl_request(comms2, {"op": "exploit"},
+                           timeout_s=10.0) is None
+    finally:
+        server2.stop()
+
+
+# -- satellite: tenant-partition snapshots on the replay shards --------------
+
+def test_tenant_partition_snapshots_restore(tmp_path):
+    comms = CommsConfig(replay_port_base=_free_port())
+    specs = {"rally": ns.TenantSpec(name="rally")}
+
+    def factory(tenant):
+        spec = specs.get(tenant)
+        return None if spec is None else _core(seed=1234)
+
+    snap_dir = str(tmp_path)
+    default_path = snapshot_path_for(snap_dir, 0)
+    # naming pin: the default partition keeps the pre-tenancy file, a
+    # tenant partition gets its own per-(shard, tenant) file
+    assert default_path.endswith("replay_shard_0.msgpack")
+    rally_path = snapshot_path_for(snap_dir, 0, tenant="rally")
+    assert rally_path.endswith("replay_shard_0.rally.msgpack")
+
+    server = ReplayShardServer(comms, 0, _core(seed=5),
+                               bind_ip="127.0.0.1", heartbeat=False,
+                               snapshot_path=default_path,
+                               snapshot_s=0.01, tenant_factory=factory,
+                               snapshot_dir=snap_dir)
+    try:
+        rally_core = server._core_for("rally")
+        assert rally_core is not None
+        for msg in _chunk_messages(11, 3):
+            server.core.ingest_msg(dict(msg))
+        for msg in _chunk_messages(12, 2):
+            rally_core.ingest_msg(dict(msg))
+        server._last_snapshot = 0.0         # force the cadence gate
+        server._maybe_snapshot()
+        assert os.path.exists(default_path)
+        assert os.path.exists(rally_path)
+        assert server.tenant_snapshots == {"rally": 1}
+        assert server.stats()["tenant_snapshots"] == {"rally": 1}
+        want_default = server.core.ingested
+        want_rally = rally_core.ingested
+        rally_leaves = [np.asarray(x).copy() for x in
+                        jax.tree_util.tree_leaves(rally_core.state)]
+    finally:
+        server.close()
+
+    # a respawned shard restores BOTH partitions warm: the default on
+    # startup (the existing path), the tenant on first sight (lazily,
+    # exactly where the partition builds)
+    comms2 = CommsConfig(replay_port_base=_free_port())
+    core2 = _core(seed=5)
+    core2.restore_snapshot(default_path)
+    server2 = ReplayShardServer(comms2, 0, core2, bind_ip="127.0.0.1",
+                                heartbeat=False,
+                                snapshot_path=default_path,
+                                snapshot_s=0.01, tenant_factory=factory,
+                                snapshot_dir=snap_dir)
+    try:
+        assert server2.core.ingested == want_default
+        rally2 = server2._core_for("rally")
+        assert rally2.ingested == want_rally
+        assert rally2.restored == want_rally
+        for a, b in zip(rally_leaves,
+                        jax.tree_util.tree_leaves(rally2.state)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+    finally:
+        server2.close()
+
+
+# -- satellite: per-tenant roster SLOs ---------------------------------------
+
+def test_roster_slos_declared_and_judged():
+    roster = {"c1": ns.TenantSpec(name="c1"),
+              "r0": ns.TenantSpec(name="r0")}
+    objs = roster_slos(roster, environ={})
+    names = [o.name for o in objs]
+    assert names == ["steps_floor@c1", "eval_score@c1",
+                     "steps_floor@r0", "eval_score@r0"]
+    by_name = {o.name: o for o in objs}
+    # progress floor judged, eval score observe-only by default
+    assert by_name["steps_floor@c1"].threshold == 0.01
+    assert by_name["eval_score@c1"].threshold is None
+    # env twins: one export sets the bar for EVERY roster tenant
+    tuned = roster_slos(roster, environ={
+        "APEX_SLO_TENANT_STEPS_RATE": "off",
+        "APEX_SLO_TENANT_EVAL_SCORE": "1.5"})
+    by_name = {o.name: o for o in tuned}
+    assert by_name["steps_floor@r0"].threshold is None
+    assert by_name["eval_score@r0"].threshold == 1.5
+    # signals walk the controller's probe summary
+    summary = {"tenants": {"c1": {"steps_rate": 2.5, "eval_score": 3.0},
+                           "r0": {"steps_rate": None}}}
+    assert resolve_signal(summary, "tenants.c1.steps_rate") == 2.5
+    assert resolve_signal(summary, "tenants.r0.steps_rate") is None
+    # a stalled lineage walks OK -> BURNING -> BREACHED under the
+    # ordinary engine machinery (fake clocks, compressed knobs)
+    now = [0.0]
+    eng = SloEngine(roster_slos(roster, environ={}),
+                    knobs=SloKnobs(fast=(10.0, 10.0), slow=(20.0, 20.0),
+                                   page_burn=1.0, warn_burn=1.0,
+                                   breach_after_s=4.0,
+                                   resolve_after_s=5.0, ok_after_s=5.0,
+                                   min_samples=1),
+                    clock=lambda: now[0], wall=lambda: 0.0)
+    stalled = {"tenants": {"c1": {"steps_rate": 0.0},
+                           "r0": {"steps_rate": 5.0}}}
+    for _ in range(40):
+        now[0] += 5.0
+        eng.sample(stalled)
+        if eng.state_of("steps_floor@c1") == "BREACHED":
+            break
+    assert eng.state_of("steps_floor@c1") == "BREACHED"
+    assert eng.state_of("steps_floor@r0") == "OK"
+
+
+def test_pbt_ctl_probe_summary_shape():
+    """The socket wrapper's SLO summary builder is pure given the
+    controller's lineage states (no sockets needed to pin it)."""
+    cfg = ApexConfig()
+    pop = _population()
+    ctl = PbtCtl.__new__(PbtCtl)        # state only; no sockets
+    ctl.ctrl = PopulationController(pop, seed=0)
+    ctl._probe_rates = {"t0": 1.5, "c1": None}
+    ctl.ctrl.observe("t0", alive=True, score=2.0, episodes=3)
+    del cfg
+    summary = ctl._slo_summary()
+    assert summary["tenants"]["t0"] == {"steps_rate": 1.5,
+                                        "eval_score": 2.0}
+    assert summary["tenants"]["c1"]["steps_rate"] is None
+    assert set(summary["tenants"]) == {"t0", "c1", "r0"}
+
+
+# -- CLI twins ---------------------------------------------------------------
+
+def test_cli_pbt_flags_and_env_twins(monkeypatch):
+    from apex_tpu.runtime.cli import build_parser
+
+    args = build_parser().parse_args(["--role", "pbt-ctl"])
+    assert args.role == "pbt-ctl"
+    assert args.pbt_decide == 30.0 and args.pbt_frac == 0.25
+    assert args.pbt_resample == 0.25 and args.pbt_min_episodes == 4
+    assert args.save_interval == 5000
+
+    monkeypatch.setenv("APEX_PBT_DECIDE_S", "10")
+    monkeypatch.setenv("APEX_PBT_FRAC", "0.5")
+    monkeypatch.setenv("APEX_PBT_RESAMPLE", "0.75")
+    monkeypatch.setenv("APEX_PBT_MIN_EPISODES", "2")
+    monkeypatch.setenv("APEX_SAVE_INTERVAL", "30")
+    args = build_parser().parse_args([])
+    assert args.pbt_decide == 10.0 and args.pbt_frac == 0.5
+    assert args.pbt_resample == 0.75 and args.pbt_min_episodes == 2
+    assert args.save_interval == 30
+    # flags beat env twins
+    args = build_parser().parse_args(["--pbt-decide", "99",
+                                      "--save-interval", "77"])
+    assert args.pbt_decide == 99.0 and args.save_interval == 77
+    # the roster env twin feeds the same loader the CLI dispatch uses
+    monkeypatch.setenv("APEX_POPULATION", json.dumps(
+        [{"name": "z", "env_id": "ApexCatchSmall-v0", "lr": 1e-3}]))
+    pop = load_population()
+    assert pop["z"].lr == 1e-3
