@@ -1132,3 +1132,108 @@ class QuotaAccounting(Rule):
                         "residency saturates at ring capacity; use "
                         "ReplayShardCore.resident()/over_quota()"))
         return out
+
+
+# -- J019 -------------------------------------------------------------------
+
+
+@register
+class CtlThreadAffinity(Rule):
+    id = "J019"
+    name = "ctl-thread-affinity"
+    description = ("learner/trainer state mutated from a FleetStatusServer "
+                   "hook: the status server runs ctl_fn/metrics_fn/"
+                   "snapshot_fn on ITS OWN thread, while train_state/"
+                   "replay_state/core and the jitted step closures are "
+                   "trainer-thread-only by contract — a hook that restores "
+                   "weights or rebinds the core races the hot loop "
+                   "mid-dispatch.  Enqueue the command on a bounded queue "
+                   "and apply it on the trainer thread's health tick "
+                   "(ConcurrentTrainer._enqueue_ctl / _drain_ctl)")
+
+    #: the server's callback keywords (fleet/registry.FleetStatusServer)
+    _HOOK_KWARGS = ("ctl_fn", "metrics_fn", "snapshot_fn")
+    #: trainer-thread-only attribute spellings (ConcurrentTrainer state)
+    _STATE = frozenset({"train_state", "replay_state", "core", "key",
+                        "learner_epoch", "param_version", "cfg",
+                        "_fused", "_train", "_ingest", "_multi",
+                        "_train_batch", "_ingest_multi"})
+    #: trainer-thread-only appliers (each mutates the state above)
+    _APPLIERS = frozenset({"restore_weights", "apply_hparams",
+                           "_apply_ctl", "_drain_ctl", "save_checkpoint",
+                           "restore"})
+
+    def _class_methods(self, cls: ast.ClassDef) -> dict:
+        return {n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _enclosing_class(self, ctx: ModuleContext,
+                         node: ast.AST) -> ast.ClassDef | None:
+        n = ctx.parents.get(node)
+        while n is not None:
+            if isinstance(n, ast.ClassDef):
+                return n
+            n = ctx.parents.get(n)
+        return None
+
+    def _scan_body(self, ctx: ModuleContext, nodes,
+                   hook_name: str) -> list[Finding]:
+        out: list[Finding] = []
+        for body_node in nodes:
+            for node in ast.walk(body_node):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr in self._STATE:
+                            out.append(ctx.finding(
+                                self, node,
+                                f"self.{attr} assigned inside the "
+                                f"status-server hook {hook_name!r} — "
+                                f"learner state is trainer-thread-only; "
+                                f"enqueue and drain on the health tick"))
+                elif isinstance(node, ast.Call):
+                    attr = _self_attr(node.func)
+                    if attr in self._APPLIERS:
+                        out.append(ctx.finding(
+                            self, node,
+                            f"self.{attr}() called inside the "
+                            f"status-server hook {hook_name!r} — it "
+                            f"mutates learner state on the server "
+                            f"thread; enqueue and drain on the health "
+                            f"tick"))
+        return out
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _callee_basename(node) == "FleetStatusServer"):
+                continue
+            cls = self._enclosing_class(ctx, node)
+            methods = self._class_methods(cls) if cls is not None else {}
+            for kwarg in self._HOOK_KWARGS:
+                hook = _kwarg(node, kwarg)
+                if hook is None:
+                    continue
+                if isinstance(hook, ast.Lambda):
+                    out.extend(self._scan_body(ctx, [hook.body], kwarg))
+                    continue
+                attr = _self_attr(hook)
+                fn = methods.get(attr) if attr else None
+                if fn is None:
+                    continue
+                # the hook body plus one level of same-class calls —
+                # enough to catch a hook delegating its mutation, without
+                # walking the trainer's whole call graph
+                bodies: list = [fn]
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call):
+                        callee = _self_attr(sub.func)
+                        target = methods.get(callee) if callee else None
+                        if target is not None and target is not fn:
+                            bodies.append(target)
+                out.extend(self._scan_body(ctx, bodies, f"{kwarg}={attr}"))
+        return out

@@ -42,6 +42,11 @@ REGISTERED_GAUGES = frozenset({
     "ondevice_chunks", "ondevice_frames", "ondevice_dispatches",
     "dispatches", "chunks", "frames", "transitions", "rollout_len",
     "n_envs",
+    # fused on-device training plane (apex_tpu/ondevice/fused.py):
+    # the fused-0 heartbeat's counter block, also the fleet_summary
+    # "ondevice" section the fused-smoke CI drill asserts on
+    "macro_steps", "train_steps", "prio_writebacks", "external_ingest",
+    "steps_per_dispatch", "train_per_step",
     # evaluator eval-ladder scores (runtime/roles.py — the SLO engine's
     # model-quality signal and the future canary/promotion gate input)
     "eval_band", "eval_episodes", "eval_score_last", "eval_score_mean",

@@ -1,0 +1,24 @@
+"""On-device replay plane: HBM-resident prioritized replay + the fused
+Sebulba train step.
+
+PR 10 moved rollouts on-device (:mod:`apex_tpu.training.anakin`); this
+package moves the REST of the training loop after it, so one jitted
+program per dispatch runs the whole
+
+    rollout -> ingest -> prioritized sample -> train -> priority write-back
+
+cycle with the host in the loop only for checkpoints, obs spans, and the
+socket fleet (arxiv 1803.02811's co-location argument taken to its
+Podracer/Sebulba limit).
+
+* :mod:`apex_tpu.ondevice.replay` — :class:`DeviceFramePool`, the
+  stateful HBM-resident twin of
+  :class:`apex_tpu.replay.frame_pool.FramePoolReplay` (same three pure
+  programs, jit-compiled with donated state, own PRNG chain, host-spill
+  snapshots riding the checkpoint machinery).
+* :mod:`apex_tpu.ondevice.fused` — :class:`FusedStep` (the scanned
+  macro-step program) and :class:`FusedApexTrainer` (the
+  ``--rollout fused`` driver on the ConcurrentTrainer path).
+"""
+
+from apex_tpu.ondevice.replay import DeviceFramePool  # noqa: F401

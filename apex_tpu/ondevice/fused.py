@@ -1,0 +1,529 @@
+"""The fused Sebulba train step: the whole Ape-X cycle in one dispatch.
+
+PR 10's ``--rollout ondevice`` fused acting (env + policy + chunk
+assembly in one scan) but still woke the host per chunk: poll -> ingest
+dispatch -> train dispatch -> write-back, with the replay-ratio loop in
+between.  :class:`FusedStep` closes the remaining hops — ONE jitted
+program per dispatch scans ``steps_per_dispatch`` macro steps of
+
+    rollout segment (AnakinRollout._dispatch, verbatim)
+    -> acting-TD priorities (device twin of the numpy epilogue)
+    -> masked ingest of every sealed chunk (FramePoolReplay.add, valid=)
+    -> [warm] P x (prioritized sample -> update_from_batch
+                   -> priority write-back)
+
+donating the train state AND the replay state so HBM never
+double-buffers.  The host wakes once per dispatch for the epilogue:
+episode stats, counters, publish/checkpoint/obs cadence.
+
+Contracts (pinned in tests/test_ondevice_replay.py):
+
+* **fused == serial.**  A ``steps_per_dispatch=N`` dispatch is
+  bit-identical to N ``steps_per_dispatch=1`` dispatches — same macro
+  body, same pre-split key chains — so the scan composition is pure
+  dispatch-latency amortization (the ``scan_fused_steps`` contract,
+  lifted to the whole training cycle).
+* **device priorities are self-consistent, not host-identical.**  The
+  acting-TD priorities compute in-program, where XLA's backend contracts
+  ``reward + discount*max`` into one FMA rounding; the host builder's
+  numpy rounds twice (the 1-ulp drift :mod:`apex_tpu.training.anakin`
+  documents — measured to survive ``lax.optimization_barrier``, bitcast
+  round-trips, and f64 detours on XLA:CPU, which is why PR 10 put its
+  priorities in the host epilogue).  The fused plane's replay is fed
+  exclusively by this program, so the contract that matters — the same
+  priorities on every path that can meet in one tree — holds by
+  construction; the <= 1-ulp envelope vs the numpy epilogue is pinned.
+* **masked ingest.**  Unsealed slots of the fixed ``[B, M]`` chunk grid
+  ingest with ``valid=False`` — a bit-exact no-op on every replay field
+  (see :meth:`FramePoolReplay.add`).
+
+Differences from the host loop, by design: acting params are the LIVE
+``train_state.params`` (zero staleness — the Anakin end-state), the
+replay ratio is STRUCTURAL (``B * rollout_len`` transitions ingested per
+``train_per_step`` updates; there is no host band controller inside the
+program), warmup gates training via ``lax.cond`` on the device ingest
+counter, and beta anneals on-device in f32 off that same counter (which
+saturates at ``max(warmup, beta_anneal)+1`` — past both thresholds the
+exact count is irrelevant, so i32 never wraps).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from apex_tpu.config import ApexConfig
+from apex_tpu.training.apex import ApexTrainer
+
+#: metric keys td_update returns — the cond's cold branch must mirror
+#: the structure exactly
+_METRIC_KEYS = ("loss", "grad_norm", "q_mean", "td_mean")
+
+
+def acting_priorities(out):
+    """Device twin of ``AnakinRollout.rollout``'s numpy priority
+    epilogue: ``|reward + discount*max(qn) - q_taken| + 1e-6`` over the
+    ``[B, M, K]`` chunk grid.  XLA contracts the multiply-add into one
+    FMA rounding where numpy rounds twice — a <= 1-ulp divergence the
+    module docstring scopes (the fused replay never mixes these with
+    host-computed priorities for the same transition)."""
+    import jax.numpy as jnp
+
+    q_taken = jnp.take_along_axis(
+        out["q0"], out["action"][..., None], -1)[..., 0]
+    target = out["reward"] + out["discount"] * out["qn"].max(-1)
+    return jnp.abs(target - q_taken) + jnp.float32(1e-6)
+
+
+class FusedStep:
+    """The jitted dispatch program plus its host-side chain/counters.
+
+    ``core`` is the family's :class:`~apex_tpu.training.learner.
+    LearnerCore` (``update_from_batch`` is the one family hook — AQL's
+    proposal sampler and R2D2's carry slot in behind it), ``replay`` the
+    :class:`FramePoolReplay` spec, ``engine`` a PR 10
+    :class:`~apex_tpu.training.anakin.AnakinRollout` whose carry/key
+    this object now owns.
+    """
+
+    def __init__(self, core, replay, engine, *, warmup: int,
+                 beta: float, beta_anneal: int,
+                 steps_per_dispatch: int = 4, train_per_step: int = 1):
+        import jax
+        import jax.numpy as jnp
+
+        if steps_per_dispatch < 1 or train_per_step < 1:
+            raise ValueError(
+                f"steps_per_dispatch={steps_per_dispatch} and "
+                f"train_per_step={train_per_step} must be >= 1 "
+                f"(--steps-per-dispatch / APEX_STEPS_PER_DISPATCH)")
+        self.core = core
+        self.replay = replay
+        self.engine = engine
+        self.N = int(steps_per_dispatch)
+        self.P = int(train_per_step)
+        self.warmup = int(warmup)
+        self.beta0 = float(beta)
+        self.anneal = max(1, int(beta_anneal))
+        # the device warm/anneal counter saturates here: beyond both
+        # thresholds the exact count no longer matters, so i32 is safe
+        # for arbitrarily long runs
+        self._ing_cap = np.int32(max(self.warmup, self.anneal) + 1)
+        self.ingested_dev = jnp.int32(0)
+        self._jit = jax.jit(self._dispatch, donate_argnums=(0, 1, 2, 3, 4))
+        # host counters (fleet_summary "ondevice" block; CI asserts)
+        self.dispatches = 0
+        self.macro_steps = 0
+        self.train_steps = 0
+        self.prio_writebacks = 0
+        self.chunks = 0
+        self.frames = 0
+        self.transitions = 0
+        self.external_ingest = 0
+
+    # -- device program ----------------------------------------------------
+
+    def _beta_at(self, ing):
+        import jax.numpy as jnp
+        frac = jnp.minimum(jnp.float32(1.0),
+                           ing.astype(jnp.float32) / self.anneal)
+        return (jnp.float32(self.beta0)
+                + jnp.float32(1.0 - self.beta0) * frac)
+
+    def _train_block(self, ts, rs, keys, ing):
+        from jax import lax
+        beta = self._beta_at(ing)
+
+        def body(carry, k):
+            ts2, rs2 = carry
+            batch, weights, idx = self.replay.sample(
+                rs2, k, self.core.batch_size, beta)
+            ts2, prios, metrics = self.core.update_from_batch(
+                ts2, batch, weights)
+            rs2 = self.replay.update_priorities(rs2, idx, prios)
+            return (ts2, rs2), metrics
+
+        (ts, rs), metrics = lax.scan(body, (ts, rs), keys)
+        return ts, rs, metrics
+
+    def _macro(self, carry, xs):
+        import jax.numpy as jnp
+        from jax import lax
+
+        ts, rs, c, cf, ing = carry
+        rkey, skeys = xs
+        eng = self.engine
+        c, cf, out = eng._dispatch(ts.params, eng.epsilons, c, cf, rkey)
+        B, M = eng.B, eng.M
+        prios = acting_priorities(out)                       # [B, M, K]
+        sealed = out["sealed"]                               # [B]
+        mask = jnp.arange(M, dtype=jnp.int32)[None, :] < sealed[:, None]
+
+        def flat(a):
+            return a.reshape((B * M,) + a.shape[2:])
+
+        slots = {k: flat(out[k]) for k in
+                 ("frames", "action", "reward", "discount",
+                  "obs_ref", "next_ref", "nf", "nt")}
+
+        def ingest(carry2, xs2):
+            rs2, ing2 = carry2
+            sl, pr, do = xs2
+            chunk = dict(frames=sl["frames"], n_frames=sl["nf"],
+                         n_trans=sl["nt"], action=sl["action"],
+                         reward=sl["reward"], discount=sl["discount"],
+                         obs_ref=sl["obs_ref"], next_ref=sl["next_ref"])
+            rs2 = self.replay.add(rs2, chunk, pr, valid=do)
+            ing2 = jnp.minimum(ing2 + jnp.where(do, sl["nt"], 0),
+                               self._ing_cap)
+            return (rs2, ing2), ()
+
+        (rs, ing), _ = lax.scan(ingest, (rs, ing),
+                                (slots, flat(prios), mask.reshape(-1)))
+
+        warm = ing >= jnp.int32(self.warmup)
+
+        def do_train(args):
+            ts2, rs2 = args
+            return self._train_block(ts2, rs2, skeys, ing)
+
+        def skip(args):
+            ts2, rs2 = args
+            zero = jnp.zeros((self.P,), jnp.float32)
+            return ts2, rs2, {k: zero for k in _METRIC_KEYS}
+
+        ts, rs, metrics = lax.cond(warm, do_train, skip, (ts, rs))
+        done, ep_ret, ep_len = out["stepped"]
+        ys = dict(metrics=metrics, trained=warm,
+                  sealed=sealed.sum(), sealed_max=sealed.max(),
+                  n_trans=jnp.where(mask, out["nt"], 0).sum(),
+                  done=done, ep_ret=ep_ret, ep_len=ep_len)
+        return (ts, rs, c, cf, ing), ys
+
+    def _dispatch(self, ts, rs, c, cf, ing, rkeys, skeys):
+        from jax import lax
+        (ts, rs, c, cf, ing), ys = lax.scan(
+            self._macro, (ts, rs, c, cf, ing), (rkeys, skeys))
+        return ts, rs, c, cf, ing, ys
+
+    # -- host surface ------------------------------------------------------
+
+    def dispatch(self, train_state, replay_state, sample_key):
+        """One device program: N macro steps.  Advances the engine's
+        rollout chain and the caller's sample chain with the exact split
+        discipline a serial run would, returns ``(train_state,
+        replay_state, sample_key, info)``."""
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.actors.pool import EpisodeStat
+
+        eng = self.engine
+        rkeys, skeys = [], []
+        for _ in range(self.N):
+            eng.key, rk = jax.random.split(eng.key)
+            rkeys.append(rk)
+            row = []
+            for _ in range(self.P):
+                sample_key, k = jax.random.split(sample_key)
+                row.append(k)
+            skeys.append(jnp.stack(row))
+        (train_state, replay_state, eng.carry, eng.carry_frames,
+         self.ingested_dev, ys) = self._jit(
+            train_state, replay_state, eng.carry, eng.carry_frames,
+            self.ingested_dev, jnp.stack(rkeys), jnp.stack(skeys))
+        got = jax.device_get(ys)
+        if int(got["sealed_max"].max(initial=0)) > eng.M - 1:
+            raise RuntimeError(
+                f"fused outbox overflow: {int(got['sealed_max'].max())} "
+                f"seals > {eng.M - 1} sealed slots — raise rollout_len "
+                f"headroom")
+        done, ep_ret, ep_len = got["done"], got["ep_ret"], got["ep_len"]
+        stats = [EpisodeStat(eng.slot_ids[b], float(ep_ret[m, t, b]),
+                             int(ep_len[m, t, b]))
+                 for m in range(self.N) for t in range(eng.T)
+                 for b in range(eng.B) if done[m, t, b]]
+        trained_mask = np.asarray(got["trained"], bool)
+        trained = int(trained_mask.sum()) * self.P
+        metrics = None
+        if trained:
+            metrics = {k: float(np.asarray(v)[trained_mask].mean())
+                       for k, v in got["metrics"].items()}
+        transitions = int(got["n_trans"].sum())
+        self.dispatches += 1
+        self.macro_steps += self.N
+        self.train_steps += trained
+        self.prio_writebacks += trained
+        self.chunks += int(got["sealed"].sum())
+        self.frames += self.N * eng.T * eng.B
+        self.transitions += transitions
+        info = dict(stats=stats, metrics=metrics, train_steps=trained,
+                    transitions=transitions,
+                    frames=self.N * eng.T * eng.B)
+        return train_state, replay_state, sample_key, info
+
+    def note_external_ingest(self, n: int) -> None:
+        """Host-path chunks (hybrid socket actors) ingested outside the
+        fused program still advance the device warm/anneal counter."""
+        import jax.numpy as jnp
+        self.ingested_dev = jnp.minimum(
+            self.ingested_dev + jnp.int32(n), self._ing_cap)
+        self.external_ingest += int(n)
+
+    def sync_ingested(self, n: int) -> None:
+        """Re-seed the device counter after a checkpoint restore."""
+        import jax.numpy as jnp
+        self.ingested_dev = jnp.minimum(jnp.int32(min(n, 2 ** 31 - 1)),
+                                        self._ing_cap)
+
+    def rebind(self, core) -> None:
+        """Re-jit against a rebuilt core (live lr application — one
+        recompile per explore, the apply_hparams contract)."""
+        import jax
+        self.core = core
+        self._jit = jax.jit(self._dispatch, donate_argnums=(0, 1, 2, 3, 4))
+
+    def counters(self) -> dict:
+        """``fleet_summary.json``'s ``ondevice`` block (the fused-smoke
+        CI job asserts these are nonzero)."""
+        return {"dispatches": self.dispatches,
+                "macro_steps": self.macro_steps,
+                "train_steps": self.train_steps,
+                "prio_writebacks": self.prio_writebacks,
+                "chunks": self.chunks, "frames": self.frames,
+                "transitions": self.transitions,
+                "external_ingest": self.external_ingest,
+                "steps_per_dispatch": self.N,
+                "train_per_step": self.P,
+                "rollout_len": self.engine.T, "n_envs": self.engine.B}
+
+
+class _IdlePool:
+    """The in-host fused topology has no actor plane at all: rollouts
+    live inside the dispatch.  This is the minimal pool surface the
+    ConcurrentTrainer helpers probe."""
+
+    def start(self) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    def publish_params(self, version: int, params) -> None:
+        pass
+
+    def poll_chunks(self, max_chunks: int, timeout: float = 0.0) -> list:
+        return []
+
+    def poll_stats(self) -> list:
+        return []
+
+
+class FusedApexTrainer(ApexTrainer):
+    """``--rollout fused``: the ConcurrentTrainer-path driver whose hot
+    loop is one :class:`FusedStep` dispatch per iteration.
+
+    Reuses the whole ApexTrainer substrate — model/replay/optimizer
+    construction, checkpoint bundle (``replay_state`` IS the on-device
+    pool, so the PR 8 machinery host-spills it for free), fleet
+    registry/status/ctl surface, SLO engine, publish cadence — and
+    replaces only the chunk-driven drain with the fused dispatch.  The
+    socket pool (when one is attached) keeps serving evaluators and the
+    param channel; any host-actor chunks that arrive are absorbed into
+    the same replay state between dispatches (hybrid mode).
+
+    Graceful refusals name their knobs: non-jittable envs fail in
+    ``make_jax_env``'s ValueError, a dp>1 mesh fails here before any
+    pool spawns, and non-DQN families fail in the CLI/role wiring.
+    """
+
+    def __init__(self, config: ApexConfig | None = None,
+                 logdir: str | None = None, verbose: bool = False,
+                 publish_min_seconds: float = 0.2,
+                 train_ratio=None, min_train_ratio=None,
+                 checkpoint_dir: str | None = None, pool=None,
+                 respawn_workers: bool = True,
+                 rollout_len: int | None = None,
+                 steps_per_dispatch: int = 4, train_per_step: int = 1):
+        cfg = config or ApexConfig()
+        if int(np.prod(cfg.learner.mesh_shape)) > 1:
+            raise ValueError(
+                f"--rollout fused requires a single-chip learner mesh "
+                f"(mesh_shape={cfg.learner.mesh_shape}) — set --mesh-dp 1 "
+                f"(APEX_MESH_DP=1); dp>1 learners stay on --rollout "
+                f"ondevice/host (ROADMAP: fused x dp mesh)")
+        # non-jittable env ids refuse HERE, before any pool/worker spawns
+        from apex_tpu.envs.registry import make_jax_env
+        make_jax_env(cfg.env.env_id, cfg.env)
+        super().__init__(cfg, logdir=logdir, verbose=verbose,
+                         publish_min_seconds=publish_min_seconds,
+                         train_ratio=train_ratio,
+                         min_train_ratio=min_train_ratio,
+                         checkpoint_dir=checkpoint_dir,
+                         pool=pool if pool is not None else _IdlePool(),
+                         respawn_workers=respawn_workers)
+        from apex_tpu.training.anakin import make_anakin_engine
+        engine = make_anakin_engine(cfg, rollout_len=rollout_len)
+        self.fused = FusedStep(
+            self.core, self.replay, engine,
+            warmup=cfg.replay.warmup, beta=cfg.replay.beta,
+            beta_anneal=cfg.replay.beta_anneal,
+            steps_per_dispatch=steps_per_dispatch,
+            train_per_step=train_per_step)
+
+    # -- the fused hot loop ------------------------------------------------
+
+    def train(self, total_steps: int, max_seconds: float = 3600.0,
+              log_every: int = 200):
+        """Run (at least) ``total_steps`` MORE learner updates — the
+        dispatch granularity means up to ``steps_per_dispatch *
+        train_per_step - 1`` overshoot."""
+        import jax.numpy as jnp
+
+        from apex_tpu.fleet.heartbeat import HeartbeatEmitter
+        from apex_tpu.fleet.registry import FleetRegistry
+        from apex_tpu.obs import spans as obs_spans
+        from apex_tpu.obs.trace import get_ring, set_process_label
+        from apex_tpu.utils.profiling import DispatchGapTimer
+
+        cfg = self.cfg
+        pool = self.pool
+        target_steps = self.steps_rate.total + total_steps
+        if self.actor_timing is None:
+            self.actor_timing = {}
+        set_process_label("learner")
+        ring = get_ring()
+        if self._obs is None:
+            self._obs = obs_spans.LearnerObs(ring=ring)
+        gap = self._dispatch_gap = DispatchGapTimer(
+            ring=ring, track="learner-fused-loop")
+        if self.fleet is None:
+            self.fleet = FleetRegistry(cfg.comms)
+        pool.start()
+        set_epoch = getattr(pool, "set_learner_epoch", None)
+        if set_epoch is not None:
+            set_epoch(self.learner_epoch)
+        self._start_status_server()
+        # the fused plane beats into the registry like AnakinPool's
+        # ondevice-0 does, so the status table shows it next to any
+        # socket peers
+        beat = HeartbeatEmitter(
+            "fused-0", role="rollout",
+            interval_s=cfg.comms.heartbeat_interval_s,
+            gauges_fn=self.fused.counters)
+        try:
+            self._publish()
+            last_publish = time.monotonic()
+            t_end = last_publish + max_seconds
+            last_pub_step = self.steps_rate.total
+            last_health = last_publish
+            self._episode_idx = 0
+            metrics = None
+
+            while self.steps_rate.total < target_steps:
+                now = time.monotonic()
+                stop = self._stop_requested
+                if now > t_end or (stop is not None and stop.is_set()):
+                    break
+                gap.about_to_dispatch()
+                (self.train_state, self.replay_state, self.key,
+                 info) = self.fused.dispatch(
+                    self.train_state, self.replay_state, self.key)
+                gap.dispatch_returned()
+                if info["train_steps"]:
+                    self.steps_rate.tick(info["train_steps"])
+                    if info["metrics"] is not None:
+                        metrics = info["metrics"]
+                self.ingested += info["transitions"]
+                self.frames_rate.tick(info["transitions"])
+                for stat in info["stats"]:
+                    self.log.scalars(
+                        {"episode_reward": stat.reward,
+                         "episode_length": stat.length,
+                         "actor_id": stat.actor_id}, self._episode_idx)
+                    self._episode_idx += 1
+                # hybrid: host-actor chunks absorb between dispatches
+                # (ingest-only — the fused program owns the train cadence)
+                for msg in pool.poll_chunks(64, timeout=0):
+                    self.replay_state = self._ingest(
+                        self.replay_state, msg["payload"],
+                        jnp.asarray(msg["priorities"]))
+                    n_new = int(msg["n_trans"])
+                    self.ingested += n_new
+                    self.frames_rate.tick(n_new)
+                    self.fused.note_external_ingest(n_new)
+                beat.tick(info["frames"])
+                hb = beat.maybe_beat(self.param_version)
+                if hb is not None:
+                    self.fleet.observe(hb)
+
+                steps = self.steps_rate.total
+                if (self.checkpointer is not None
+                        and steps - self._last_save
+                        >= cfg.learner.save_interval):
+                    self.save_checkpoint()
+                    self._last_save = steps
+                if steps:
+                    due = (now - last_publish >= self.publish_min_seconds
+                           and (steps - last_pub_step
+                                >= cfg.learner.publish_interval
+                                or now - last_publish
+                                > 10 * self.publish_min_seconds))
+                else:
+                    due = (getattr(pool, "needs_warmup_republish", False)
+                           and now - last_publish
+                           > 10 * self.publish_min_seconds)
+                if due:
+                    self._publish()
+                    last_publish = now
+                    last_pub_step = steps
+                if self.respawn_workers and now - last_health >= 5.0:
+                    self._health_tick(steps)
+                    last_health = now
+                self._drain_stats(steps)
+                if metrics is not None \
+                        and steps - self._last_log >= log_every:
+                    extra = gap.snapshot()
+                    if self._obs is not None:
+                        extra |= self._obs.scalars()
+                    self.log.scalars(
+                        {k: float(v) for k, v in metrics.items()}
+                        | {"bps": self.steps_rate.rate,
+                           "fps": self.frames_rate.rate,
+                           "param_version": self.param_version,
+                           "ingested": self.ingested} | extra, steps)
+                    self._last_log = steps
+        finally:
+            if self._fleet_status is not None:
+                self._fleet_status.stop()
+                self._fleet_status = None
+            self._dump_fleet_summary()
+            pool.cleanup()
+            stop = self._stop_requested
+            if stop is not None:
+                stop.clear()
+        return self
+
+    # -- surface integration ----------------------------------------------
+
+    def fleet_summary(self):
+        snap = super().fleet_summary()
+        if snap is not None and getattr(self, "fused", None) is not None:
+            # the fused-smoke CI drill asserts these from the persisted
+            # summary (dispatches/chunks/transitions + >=1 write-back)
+            snap["metrics"]["ondevice"] = self.fused.counters()
+        return snap
+
+    def _apply_counters(self, meta: dict) -> None:
+        super()._apply_counters(meta)
+        self.fused.sync_ingested(self.ingested)
+
+    def apply_hparams(self, h: dict) -> dict:
+        applied = super().apply_hparams(h)
+        if "lr" in applied:
+            # the fused program closed over the old core's optimizer —
+            # rebind + re-jit (one recompile per explore, same contract
+            # as the host loop's hot-fn rebuild)
+            self.fused.rebind(self.core)
+        return applied

@@ -1,0 +1,136 @@
+"""HBM-resident prioritized replay: the stateful device twin of
+:class:`~apex_tpu.replay.frame_pool.FramePoolReplay`.
+
+The host pool is a frozen SPEC of three pure programs (add / sample /
+update_priorities) that drivers orchestrate from the hot loop.
+:class:`DeviceFramePool` binds those SAME programs — jit-compiled with
+donated state so HBM never double-buffers — to one resident
+:class:`~apex_tpu.replay.frame_pool.FramePoolState` plus its own PRNG
+chain, with the exact key-split discipline the concurrent trainer uses
+(``self.key, k = split(self.key)`` before every sample).  Bit-parity
+against a host-orchestrated pool — every tree field, the key chain, the
+sampled indices and batches — is pinned in
+``tests/test_ondevice_replay.py``; there is no second implementation to
+drift.
+
+Durability is the PR 8 host-spill path: :meth:`snapshot` serializes the
+whole pool (state + key chain + counters + spec pins) through the
+checkpoint machinery (:func:`apex_tpu.training.checkpoint.save_bundle`,
+atomic tmp+rename) and :meth:`restore` refuses a shape-shifting restore
+with an actionable error, exactly like the replay-shard snapshots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.replay.frame_pool import FramePoolReplay
+
+
+class DeviceFramePool:
+    """One HBM-resident frame-pool replay shard driven from the host.
+
+    ``spec`` is the frozen :class:`FramePoolReplay`; the pool owns the
+    state, the sample-key chain, and host-side counters.  All three
+    mutating methods re-point ``self.state`` at the donated result — the
+    previous buffers are invalid the moment a method returns, which is
+    the point: replay never leaves HBM and never double-buffers.
+    """
+
+    def __init__(self, spec: FramePoolReplay, seed: int = 0, key=None):
+        self.spec = spec
+        self.state = spec.init()
+        self.key = jax.random.key(seed) if key is None else key
+        self._add = jax.jit(spec.add, donate_argnums=(0,))
+        self._update = jax.jit(spec.update_priorities, donate_argnums=(0,))
+        self._sample_jits: dict[int, object] = {}
+        # host observability (snapshot meta; the fused loop keeps its own)
+        self.adds = 0
+        self.samples = 0
+        self.updates = 0
+        self.ingested = 0
+
+    # -- the three programs ------------------------------------------------
+
+    def add(self, chunk: dict, priorities) -> None:
+        # host-driven twin of the jitted spec program — this method body
+        # never traces (J002's name-based jit-scope match sees the
+        # spec.add jit above and cannot tell the two apart)
+        n = int(chunk["n_trans"])  # apexlint: disable=J002
+        self.state = self._add(self.state, chunk,
+                               jnp.asarray(priorities, jnp.float32))
+        self.adds += 1
+        self.ingested += n
+
+    def sample(self, batch_size: int, beta):
+        """``(batch, weights, idx)`` — advances the key chain exactly as
+        the concurrent trainer's ``self.key, k = split(self.key)`` does,
+        so a host-pool replay of the same chunk stream samples the same
+        indices (the parity pin)."""
+        fn = self._sample_jits.get(batch_size)
+        if fn is None:
+            fn = jax.jit(self.spec.sample, static_argnums=(2,))
+            self._sample_jits[batch_size] = fn
+        self.key, k = jax.random.split(self.key)
+        self.samples += 1
+        return fn(self.state, k, batch_size, jnp.float32(beta))
+
+    def update_priorities(self, idx, priorities) -> None:
+        self.state = self._update(self.state, jnp.asarray(idx),
+                                  jnp.asarray(priorities, jnp.float32))
+        self.updates += 1
+
+    # -- host-spill durability (PR 8 checkpoint machinery) -----------------
+
+    def _spec_pins(self) -> dict:
+        s = self.spec
+        return dict(capacity=s.capacity,
+                    frame_shape=list(s.frame_shape),
+                    frame_stack=s.frame_stack,
+                    frame_capacity=s.f_capacity,
+                    frame_dtype=s.frame_dtype,
+                    alpha=s.alpha, eps=s.eps)
+
+    def snapshot(self, path: str) -> str:
+        """Spill the whole pool to ``path`` (atomic tmp+rename)."""
+        from apex_tpu.training.checkpoint import save_bundle
+        bundle = dict(state=self.state, key=jax.random.key_data(self.key))
+        meta = dict(counters=dict(adds=self.adds, samples=self.samples,
+                                  updates=self.updates,
+                                  ingested=self.ingested),
+                    **self._spec_pins())
+        return save_bundle(path, bundle, meta)
+
+    def restore(self, path: str) -> None:
+        """Warm-restore state + key chain + counters; a snapshot written
+        by a DIFFERENT spec refuses loudly instead of silently reshaping
+        the ring (the replay-shard snapshot contract)."""
+        from apex_tpu.training.checkpoint import restore_bundle
+        pins = self._spec_pins()
+        target = dict(state=self.spec.init(),
+                      key=jax.random.key_data(self.key))
+        bundle, meta = restore_bundle(path, target)
+        for k, want in pins.items():
+            got = meta.get(k)
+            if got != want:
+                raise ValueError(
+                    f"snapshot {path!r} was written by a different pool "
+                    f"spec: {k}={got!r} != {want!r} — restore into a "
+                    f"matching FramePoolReplay or discard the snapshot")
+        self.state = bundle["state"]
+        self.key = jax.random.wrap_key_data(bundle["key"])
+        c = meta.get("counters", {})
+        self.adds = int(c.get("adds", 0))
+        self.samples = int(c.get("samples", 0))
+        self.updates = int(c.get("updates", 0))
+        self.ingested = int(c.get("ingested", 0))
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> dict:
+        return {"adds": self.adds, "samples": self.samples,
+                "updates": self.updates, "ingested": self.ingested,
+                "size": int(np.asarray(jax.device_get(self.state.size))),
+                "hbm_bytes": self.spec.hbm_bytes()}

@@ -201,7 +201,7 @@ class FramePoolReplay(PERMethods):
     # -- mutation (pure) ---------------------------------------------------
 
     def add(self, state: FramePoolState, chunk: dict,
-            priorities: jax.Array) -> FramePoolState:
+            priorities: jax.Array, valid=None) -> FramePoolState:
         """Ingest one self-contained chunk (see module docstring).
 
         ``chunk`` keys: ``frames`` u8[Kf, D], ``n_frames`` i32, ``n_trans``
@@ -219,6 +219,16 @@ class FramePoolReplay(PERMethods):
         chunk here, so one merged ingest records the SAME per-transition
         epochs a sequential chunk-by-chunk ingest would — bit-identical
         staleness detection, pinned in tests/test_ingest_pipeline.py.
+
+        ``valid`` (scalar bool, traced) masks the WHOLE ingest: False
+        leaves every field of ``state`` bit-identical, True is
+        bit-identical to the unmasked call (both pinned in
+        tests/test_ondevice_replay.py).  The fused on-device loop
+        (:mod:`apex_tpu.ondevice.fused`) scans over a fixed chunk-slot
+        grid whose unsealed slots carry garbage — this is how they
+        ingest as no-ops inside one compiled program.  ``None`` (the
+        host path) compiles exactly the historical program: no selects,
+        no redirects.
         """
         kf = chunk["frames"].shape[0]
         k = priorities.shape[0]
@@ -260,7 +270,6 @@ class FramePoolReplay(PERMethods):
         if len(self.ring_shape) == 3:            # tile-align (see ring_shape)
             rows = jnp.pad(rows, ((0, 0), (0, self.row_dim - self.frame_dim)))
             rows = rows.reshape(kf, 8, self.row_dim // 8)
-        frames = state.frames.at[fidx].set(rows)
 
         trow = jnp.minimum(jnp.arange(k, dtype=jnp.int32),
                            chunk["n_trans"] - 1)
@@ -269,31 +278,65 @@ class FramePoolReplay(PERMethods):
         next_ids = (fpos + chunk["next_ref"]) % f
 
         p_alpha = self._to_tree_priority(priorities)
-        sum_tree, min_tree = tree_ops.update_both(
-            state.sum_tree, state.min_tree, tidx, p_alpha)
+        if valid is None:
+            frames = state.frames.at[fidx].set(rows)
+
+            def tset(arr, vals):
+                return arr.at[tidx].set(vals)
+
+            sum_tree, min_tree = tree_ops.update_both(
+                state.sum_tree, state.min_tree, tidx, p_alpha)
+        else:
+            # masked ingest: scatters redirect to an out-of-range row and
+            # DROP; the trees instead re-write their CURRENT leaf values
+            # (propagation recomputes identical reductions — a bit-exact
+            # no-op), because a dropped leaf write would still recompute
+            # ancestors from an out-of-bounds child gather
+            frames = state.frames.at[
+                jnp.where(valid, fidx, f)].set(rows, mode="drop")
+            tdrop = jnp.where(valid, tidx, c)
+
+            def tset(arr, vals):
+                return arr.at[tdrop].set(vals, mode="drop")
+
+            sum_tree = tree_ops.update_sum(
+                state.sum_tree, tidx,
+                jnp.where(valid, p_alpha,
+                          tree_ops.get_leaves(state.sum_tree, tidx)))
+            min_tree = tree_ops.update_min(
+                state.min_tree, tidx,
+                jnp.where(valid, p_alpha,
+                          tree_ops.get_leaves(state.min_tree, tidx)))
 
         epoch = state.f_epoch
         if epoch_off is not None:
             epoch = epoch + epoch_off.astype(jnp.int32)
 
+        def scalar(new, old):
+            return new if valid is None else jnp.where(valid, new, old)
+
         return state.replace(
             frames=frames,
-            extras={name: state.extras[name].at[tidx].set(
-                        chunk["extras"][name].astype(jnp.float32))
+            extras={name: tset(state.extras[name],
+                               chunk["extras"][name].astype(jnp.float32))
                     for name, _ in self.extra_spec},
-            action=state.action.at[tidx].set(chunk["action"].astype(jnp.int32)),
-            reward=state.reward.at[tidx].set(
-                chunk["reward"].astype(jnp.float32)),
-            discount=state.discount.at[tidx].set(
-                chunk["discount"].astype(jnp.float32)),
-            obs_ids=state.obs_ids.at[tidx].set(obs_ids),
-            next_ids=state.next_ids.at[tidx].set(next_ids),
-            frame_epoch=state.frame_epoch.at[tidx].set(epoch),
+            action=tset(state.action, chunk["action"].astype(jnp.int32)),
+            reward=tset(state.reward, chunk["reward"].astype(jnp.float32)),
+            discount=tset(state.discount,
+                          chunk["discount"].astype(jnp.float32)),
+            obs_ids=tset(state.obs_ids, obs_ids),
+            next_ids=tset(state.next_ids, next_ids),
+            frame_epoch=tset(state.frame_epoch,
+                             jnp.broadcast_to(epoch, (k,))),
             sum_tree=sum_tree, min_tree=min_tree,
-            pos=(state.pos + chunk["n_trans"]) % c,
-            f_epoch=state.f_epoch + chunk["n_frames"],
-            size=jnp.minimum(state.size + chunk["n_trans"], c),
-            max_priority=jnp.maximum(state.max_priority, priorities.max()),
+            pos=scalar((state.pos + chunk["n_trans"]) % c, state.pos),
+            f_epoch=scalar(state.f_epoch + chunk["n_frames"],
+                           state.f_epoch),
+            size=scalar(jnp.minimum(state.size + chunk["n_trans"], c),
+                        state.size),
+            max_priority=scalar(
+                jnp.maximum(state.max_priority, priorities.max()),
+                state.max_priority),
         )
 
     # update_priorities / is_weights / _to_tree_priority: PERMethods.

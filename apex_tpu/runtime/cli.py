@@ -72,21 +72,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--family", default=e.get("APEX_FAMILY", "dqn"),
                    choices=["dqn", "aql", "r2d2"])
     p.add_argument("--rollout", default=e.get("APEX_ROLLOUT", "host"),
-                   choices=["host", "ondevice"],
+                   choices=["host", "ondevice", "fused"],
                    help="learner/apex roles: 'ondevice' co-locates an "
                         "Anakin rollout engine with the learner — env "
                         "step + epsilon-greedy policy + chunk assembly "
                         "fuse into one lax.scan on the training device, "
                         "params never leave it (jittable envs only: "
                         "ApexCatch*/ApexRally*; see envs/registry."
-                        "make_jax_env).  'host' (default) keeps the "
-                        "generic actor-process pipeline")
+                        "make_jax_env).  'fused' goes further "
+                        "(apex_tpu/ondevice): rollout + ingest + "
+                        "prioritized sample + train + priority "
+                        "write-back run as ONE jitted program per "
+                        "dispatch — the host wakes once per "
+                        "--steps-per-dispatch macro steps (dqn family, "
+                        "dp=1, in-learner replay only).  'host' "
+                        "(default) keeps the generic actor-process "
+                        "pipeline")
     p.add_argument("--rollout-len", type=int,
                    default=int(e.get("APEX_ROLLOUT_LEN", 0)),
                    help="on-device scan length per dispatch (env steps "
                         "per slot); 0 derives the chunk size "
                         "(--send-interval twin) so each dispatch seals "
                         "about one chunk per env slot")
+    p.add_argument("--steps-per-dispatch", type=int,
+                   default=int(e.get("APEX_STEPS_PER_DISPATCH", 4)),
+                   help="--rollout fused: macro steps (rollout segment "
+                        "-> ingest -> train -> write-back) scanned into "
+                        "one device dispatch (env twin "
+                        "APEX_STEPS_PER_DISPATCH); the host wakes once "
+                        "per dispatch for publish/checkpoint/stats")
     # multi-tenant namespace (apex_tpu/tenancy): a whole tenant's roles
     # opt in with one env export (or this flag twin); everything — wire
     # identities, chunk ids, param topics, infer requests — qualifies
@@ -475,7 +489,8 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                     min_train_ratio=args.min_train_ratio,
                     barrier_timeout_s=args.barrier_timeout,
                     restore=args.restore, rollout=args.rollout,
-                    rollout_len=args.rollout_len or None)
+                    rollout_len=args.rollout_len or None,
+                    steps_per_dispatch=args.steps_per_dispatch)
     elif args.role == "loadgen":
         # standalone on-device rollout fleet (training/anakin.py): ships
         # device-rate sealed chunks at the learner / replay shards — the
@@ -631,7 +646,23 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
                     ApexTrainer as trainer_cls
             extra = dict(train_ratio=args.train_ratio,
                          min_train_ratio=args.min_train_ratio)
-            if args.rollout == "ondevice":
+            if args.rollout == "fused":
+                # the whole rollout -> ingest -> sample -> train ->
+                # write-back cycle as one device program per dispatch
+                # (apex_tpu/ondevice); make_jax_env's ValueError names
+                # non-jittable env ids, the mesh guard names --mesh-dp,
+                # and the family gate fails loud before construction
+                if args.family != "dqn":
+                    raise NotImplementedError(
+                        f"--rollout fused currently serves the dqn "
+                        f"family only (got {args.family!r}) — aql/r2d2 "
+                        f"slot in behind the same scan hooks "
+                        f"(ROADMAP.md)")
+                from apex_tpu.ondevice.fused import FusedApexTrainer
+                trainer_cls = FusedApexTrainer
+                extra["rollout_len"] = args.rollout_len or None
+                extra["steps_per_dispatch"] = args.steps_per_dispatch
+            elif args.rollout == "ondevice":
                 # co-located Anakin rollouts replace the actor processes;
                 # make_jax_env raises a ValueError naming non-jittable
                 # env ids, and the family gate fails loud before any

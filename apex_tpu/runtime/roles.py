@@ -148,7 +148,8 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
                 checkpoint_dir: str | None = None, train_ratio=None,
                 min_train_ratio=None, queue_depth: int = 64,
                 barrier_timeout_s: float = 120.0, restore: bool = False,
-                rollout: str = "host", rollout_len: int | None = None):
+                rollout: str = "host", rollout_len: int | None = None,
+                steps_per_dispatch: int = 4):
     """Learner role: barrier -> publish -> fused ingest+train loop.
 
     ``n_peers`` = actors + evaluators expected at the startup barrier
@@ -159,9 +160,45 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
     serving any host actors/evaluators while sealed chunks ALSO stream
     from the fused on-device scan — params hand to the engine as device
     arrays, never leaving the accelerator.
+
+    ``rollout="fused"`` goes further (:mod:`apex_tpu.ondevice`): the
+    whole rollout -> ingest -> sample -> train -> write-back cycle runs
+    as ONE jitted program per dispatch; the socket pool keeps serving
+    evaluators/status, host-actor chunks absorb between dispatches, and
+    the host wakes once per ``steps_per_dispatch`` macro steps.
     """
     pool = transport.RemotePool(cfg.comms, n_peers, queue_depth=queue_depth,
                                 barrier_timeout_s=barrier_timeout_s)
+    if rollout == "fused":
+        if family != "dqn":
+            pool.cleanup()
+            raise NotImplementedError(
+                f"--rollout fused currently serves the dqn family only "
+                f"(got {family!r}) — aql/r2d2 slot in behind the same "
+                f"scan hooks (ROADMAP.md)")
+        if cfg.comms.replay_shards > 0:
+            pool.cleanup()
+            raise ValueError(
+                "--rollout fused owns replay on-device — run with "
+                "--replay-shards 0 (APEX_REPLAY_SHARDS=0); the shard "
+                "fleet serves the host topologies")
+        from apex_tpu.ondevice.fused import FusedApexTrainer
+        try:
+            # make_jax_env's ValueError names non-jittable env ids and
+            # the mesh guard names --mesh-dp, both before train()
+            trainer = FusedApexTrainer(
+                cfg, logdir=logdir, verbose=verbose,
+                checkpoint_dir=checkpoint_dir, train_ratio=train_ratio,
+                min_train_ratio=min_train_ratio, pool=pool,
+                rollout_len=rollout_len,
+                steps_per_dispatch=steps_per_dispatch)
+            if restore:
+                trainer.restore()
+        except BaseException:
+            pool.cleanup()
+            raise
+        return trainer.train(total_steps=total_steps,
+                             max_seconds=max_seconds)
     if rollout == "ondevice":
         if family != "dqn":
             pool.cleanup()

@@ -178,6 +178,9 @@ class ConcurrentTrainer(CheckpointableTrainer):
     _ctl_queue = None
     _population_ctl: dict | None = None
     hparams_live: dict | None = None
+    # episode-scalar log index for the stats drain (reset per train()
+    # call; an attribute so the fused on-device loop shares the drain)
+    _episode_idx = 0
 
     # -- param plane -------------------------------------------------------
 
@@ -330,26 +333,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
             set_epoch(self.learner_epoch)
         if client is not None:
             client.learner_epoch = self.learner_epoch
-        if hasattr(pool, "peer_seen") and self._fleet_status is None:
-            # socket learner: serve live registry snapshots for
-            # `--role status` (own REP socket + thread; a bind failure —
-            # e.g. two learners on one host — degrades to no status
-            # surface, never to a dead learner)
-            try:
-                from apex_tpu.fleet.registry import FleetStatusServer
-                if self._ctl_queue is None:
-                    # built BEFORE the server thread starts (the enqueue
-                    # hook runs on that thread); bounded so a runaway
-                    # controller can only ever park 8 commands
-                    import queue as queue_lib
-                    self._ctl_queue = queue_lib.Queue(maxsize=8)
-                self._fleet_status = FleetStatusServer(
-                    cfg.comms, self.fleet, metrics_fn=self._metrics_text,
-                    snapshot_fn=self.fleet_summary,
-                    ctl_fn=self._enqueue_ctl)
-                self._fleet_status.start()
-            except Exception:
-                self._fleet_status = None
+        self._start_status_server()
         if pipeline is not None:
             # staging starts only once the pool is live: its thread owns
             # every poll_chunks/publish_params call from here to stop()
@@ -359,7 +343,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
             self._publish()
             last_publish = time.monotonic()
             t_end = last_publish + max_seconds
-            episode_idx = 0
+            self._episode_idx = 0
             # interval-since-last semantics (not ``% interval == 0``): a
             # scan dispatch ticks the step counter by K, which can jump
             # over any exact multiple.  Save/log marks live on self.
@@ -499,70 +483,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # machine (config thresholds in CommsConfig — this
                 # replaced the old hardcoded silent_peers(60.0) report).
                 if self.respawn_workers and now - last_health >= 5.0:
-                    if hasattr(pool, "dead_workers"):      # local fleets
-                        for dead in pool.dead_workers():
-                            self.log.scalars({"worker_respawn": dead}, steps)
-                            pool.respawn_worker(dead)
-                    if hasattr(pool, "peer_seen"):         # socket fleets:
-                        # chunk arrivals count as liveness even when a
-                        # backpressured actor's stat puts drop
-                        self.fleet.observe_seen(pool.peer_seen())
-                    for ident, old, new in self.fleet.tick():
-                        self.log.scalars(
-                            {f"fleet_{new.lower()}_transition": 1.0}, steps)
-                        if self.log.verbose or new in ("SUSPECT", "DEAD"):
-                            print(f"fleet: {ident} {old} -> {new}",
-                                  flush=True)
-                    fm = self.fleet.metrics()
-                    if fm["peers"]:
-                        self.log.scalars(
-                            {"fleet_alive": fm["alive"],
-                             "fleet_suspect": fm["suspect"],
-                             "fleet_dead": fm["dead"],
-                             "fleet_parked": fm["parked"],
-                             "fleet_rejoins": fm["rejoins"]}, steps)
-                    # judge BEFORE reacting: the floor reaction consults
-                    # the actor-capacity alert the sample just advanced
-                    self._slo_tick(steps)
-                    self._react_to_fleet(steps)
-                    # PBT ctl commands drain HERE (trainer thread): the
-                    # status thread only ever enqueued them, so the
-                    # weight copy / optimizer rebuild touch learner
-                    # state from exactly one thread
-                    self._drain_ctl(steps)
-                    self._dump_fleet_summary()
+                    self._health_tick(steps)
                     last_health = now
 
-                for stat in pool.poll_stats():
-                    self.stat_drops += getattr(stat, "dropped_stats", 0)
-                    if isinstance(stat, Heartbeat):
-                        self.fleet.observe(stat)
-                        continue
-                    if isinstance(stat, ServingStat):
-                        self.serving_state = dict(stat.snapshot)
-                        continue
-                    if isinstance(stat, TenancyStat):
-                        self.tenancy_state = dict(stat.snapshot)
-                        continue
-                    if isinstance(stat, PopulationStat):
-                        self.population_state = dict(stat.snapshot)
-                        continue
-                    if isinstance(stat, ActorTimingStat):
-                        self.actor_timing[stat.actor_id] = stat
-                        self.log.scalars(
-                            {"actor_fps": stat.frames_per_sec,
-                             "actor_policy_wait_frac":
-                                 stat.policy_wait_frac,
-                             "actor_env_step_frac": stat.env_step_frac,
-                             "actor_drain_frac": stat.drain_frac,
-                             "actor_dispatch_gap_ms_p50":
-                                 stat.dispatch_gap_ms_p50}, steps)
-                        continue
-                    self.log.scalars(
-                        {"episode_reward": stat.reward,
-                         "episode_length": stat.length,
-                         "actor_id": stat.actor_id}, episode_idx)
-                    episode_idx += 1
+                self._drain_stats(steps)
 
                 # metrics is None until the first train dispatch, so the
                 # gate needs no warm check — and in service mode the
@@ -606,6 +530,104 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 # this run; the NEXT call then starts fresh
                 stop.clear()
         return self
+
+    def _start_status_server(self) -> None:
+        """Socket learner: serve live registry snapshots for
+        ``--role status`` (own REP socket + thread; a bind failure —
+        e.g. two learners on one host — degrades to no status surface,
+        never to a dead learner).  Shared by the chunk-driven loop and
+        the fused on-device loop (:mod:`apex_tpu.ondevice.fused`)."""
+        if not hasattr(self.pool, "peer_seen") \
+                or self._fleet_status is not None:
+            return
+        try:
+            from apex_tpu.fleet.registry import FleetStatusServer
+            if self._ctl_queue is None:
+                # built BEFORE the server thread starts (the enqueue
+                # hook runs on that thread); bounded so a runaway
+                # controller can only ever park 8 commands
+                import queue as queue_lib
+                self._ctl_queue = queue_lib.Queue(maxsize=8)
+            self._fleet_status = FleetStatusServer(
+                self.cfg.comms, self.fleet,
+                metrics_fn=self._metrics_text,
+                snapshot_fn=self.fleet_summary,
+                ctl_fn=self._enqueue_ctl)
+            self._fleet_status.start()
+        except Exception:
+            self._fleet_status = None
+
+    def _health_tick(self, steps: int) -> None:
+        """One health tick: respawns, registry machine, SLO judgment,
+        fleet reactions, ctl drain, summary dump.  Shared by both hot
+        loops — the caller owns the 5s cadence gate."""
+        pool = self.pool
+        if hasattr(pool, "dead_workers"):      # local fleets
+            for dead in pool.dead_workers():
+                self.log.scalars({"worker_respawn": dead}, steps)
+                pool.respawn_worker(dead)
+        if hasattr(pool, "peer_seen"):         # socket fleets:
+            # chunk arrivals count as liveness even when a
+            # backpressured actor's stat puts drop
+            self.fleet.observe_seen(pool.peer_seen())
+        for ident, old, new in self.fleet.tick():
+            self.log.scalars(
+                {f"fleet_{new.lower()}_transition": 1.0}, steps)
+            if self.log.verbose or new in ("SUSPECT", "DEAD"):
+                print(f"fleet: {ident} {old} -> {new}", flush=True)
+        fm = self.fleet.metrics()
+        if fm["peers"]:
+            self.log.scalars(
+                {"fleet_alive": fm["alive"],
+                 "fleet_suspect": fm["suspect"],
+                 "fleet_dead": fm["dead"],
+                 "fleet_parked": fm["parked"],
+                 "fleet_rejoins": fm["rejoins"]}, steps)
+        # judge BEFORE reacting: the floor reaction consults
+        # the actor-capacity alert the sample just advanced
+        self._slo_tick(steps)
+        self._react_to_fleet(steps)
+        # PBT ctl commands drain HERE (trainer thread): the
+        # status thread only ever enqueued them, so the
+        # weight copy / optimizer rebuild touch learner
+        # state from exactly one thread
+        self._drain_ctl(steps)
+        self._dump_fleet_summary()
+
+    def _drain_stats(self, steps: int) -> None:
+        """Drain the pool's stat stream: heartbeats into the registry,
+        controller snapshots into their sections, timing/episode stats
+        into the scalar log.  Shared by both hot loops."""
+        for stat in self.pool.poll_stats():
+            self.stat_drops += getattr(stat, "dropped_stats", 0)
+            if isinstance(stat, Heartbeat):
+                self.fleet.observe(stat)
+                continue
+            if isinstance(stat, ServingStat):
+                self.serving_state = dict(stat.snapshot)
+                continue
+            if isinstance(stat, TenancyStat):
+                self.tenancy_state = dict(stat.snapshot)
+                continue
+            if isinstance(stat, PopulationStat):
+                self.population_state = dict(stat.snapshot)
+                continue
+            if isinstance(stat, ActorTimingStat):
+                self.actor_timing[stat.actor_id] = stat
+                self.log.scalars(
+                    {"actor_fps": stat.frames_per_sec,
+                     "actor_policy_wait_frac":
+                         stat.policy_wait_frac,
+                     "actor_env_step_frac": stat.env_step_frac,
+                     "actor_drain_frac": stat.drain_frac,
+                     "actor_dispatch_gap_ms_p50":
+                         stat.dispatch_gap_ms_p50}, steps)
+                continue
+            self.log.scalars(
+                {"episode_reward": stat.reward,
+                 "episode_length": stat.length,
+                 "actor_id": stat.actor_id}, self._episode_idx)
+            self._episode_idx += 1
 
     def actor_plane(self) -> dict | None:
         """Aggregate actor-plane view from the latest per-worker

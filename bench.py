@@ -1033,7 +1033,16 @@ def bench_ondevice_rollout() -> dict:
     contextualizes, and the lane a TPU run blows open (the conv is ~free
     on the MXU while the host lane stays CPU-bound).  ``chunks_per_sec``/
     ``transitions_per_sec`` are the sealed-chunk rate into the replay
-    path — the loadgen saturation figure."""
+    path — the loadgen saturation figure.
+
+    The third lane ``ondevice_fused`` (apex_tpu/ondevice/fused.py) runs
+    the WHOLE training cycle — rollout + ingest + prioritized sample +
+    train + priority write-back — as one device program per dispatch and
+    reports acting throughput (``frames_per_sec``, apples-to-apples with
+    the other lanes, which do no training) PLUS ``train_steps_per_sec``,
+    the number the host loops pay dispatch round-trips for.  Leaf names
+    end in ``per_sec`` so the ``obs.slo --check`` differ classifies the
+    lane higher-better automatically."""
     import jax
     import numpy as np
 
@@ -1107,6 +1116,46 @@ def bench_ondevice_rollout() -> dict:
                           "seconds": round(hdt, 2)}
             fam.close()
 
+        # lane 3: the fused train step — fresh engine/replay/train state
+        # so the acting key chains match the ondevice lane's shape
+        from apex_tpu.ondevice.fused import FusedStep
+        from apex_tpu.replay.frame_pool import FramePoolReplay
+        from apex_tpu.training.learner import LearnerCore
+        spd = 2
+        replay = FramePoolReplay(
+            capacity=4096, frame_shape=frame_shape,
+            frame_stack=frame_stack,
+            frame_dtype=np.dtype(frame_dtype).name)
+        fused = FusedStep(
+            LearnerCore(apply_fn=model.apply, replay=replay,
+                        optimizer=make_optimizer(), batch_size=64,
+                        target_update_interval=500),
+            replay, make_anakin_engine(cfg, rollout_len=rollout_len),
+            warmup=256, beta=0.4, beta_anneal=50_000,
+            steps_per_dispatch=spd)
+        fts = create_train_state(model, make_optimizer(),
+                                 jax.random.key(1),
+                                 np.zeros((1,) + stacked, frame_dtype))
+        frs, fkey = replay.init(), jax.random.key(3)
+        fts, frs, fkey, _ = fused.dispatch(fts, frs, fkey)  # compile+warm
+        base_steps, base_trans = fused.train_steps, fused.transitions
+        fdisp = max(2, dispatches // 2)
+        t0 = time.perf_counter()
+        for _ in range(fdisp):
+            fts, frs, fkey, _ = fused.dispatch(fts, frs, fkey)
+        fdt = time.perf_counter() - t0
+        out["ondevice_fused"] = {
+            "n_envs": n_envs, "rollout_len": fused.engine.T,
+            "steps_per_dispatch": spd, "dispatches": fdisp,
+            "frames_per_sec":
+                round(fdisp * spd * fused.engine.T * fused.engine.B
+                      / fdt, 1),
+            "train_steps_per_sec":
+                round((fused.train_steps - base_steps) / fdt, 2),
+            "transitions_per_sec":
+                round((fused.transitions - base_trans) / fdt, 1),
+            "seconds": round(fdt, 2)}
+
         ond = out["ondevice"]["frames_per_sec"]
         out["speedup"] = (round(ond
                                 / out["host_default"]["frames_per_sec"],
@@ -1116,6 +1165,10 @@ def bench_ondevice_rollout() -> dict:
         out["speedup_vs_wide"] = (
             round(ond / out["host_wide"]["frames_per_sec"], 2)
             if out["host_wide"]["frames_per_sec"] else None)
+        out["fused_speedup"] = (
+            round(out["ondevice_fused"]["frames_per_sec"]
+                  / out["host_default"]["frames_per_sec"], 2)
+            if out["host_default"]["frames_per_sec"] else None)
         return out
 
     toy = EnvConfig(env_id="ApexCatchSmall-v0", frame_stack=2,

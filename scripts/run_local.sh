@@ -72,8 +72,13 @@ export APEX_REPLAY_SHARDS="$REPLAY_SHARDS"
 # env+policy+chunk-assembly scan with the fused trainer — params never
 # leave the device, sealed chunks enter the normal replay path, and the
 # topology can run with ZERO host actors (N_ACTORS=0; the evaluator
-# still rides the param stream).  Jittable envs only
-# (ApexCatch*/ApexRally* — the CLI fails loud otherwise).
+# still rides the param stream).  APEX_ROLLOUT=fused goes all the way
+# (apex_tpu/ondevice): rollout + ingest + prioritized sample + train +
+# priority write-back run as ONE jitted program per dispatch, the host
+# waking once per APEX_STEPS_PER_DISPATCH macro steps (requires
+# APEX_REPLAY_SHARDS=0 — the fused loop owns replay on-device).
+# Jittable envs only (ApexCatch*/ApexRally* — the CLI fails loud
+# otherwise).
 export APEX_ROLLOUT="${APEX_ROLLOUT:-host}"
 
 # Centralized inference plane (apex_tpu/infer_service): export

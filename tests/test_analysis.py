@@ -1564,6 +1564,91 @@ def test_baseline_line_number_drift_still_matches():
     assert not new and matched and not stale
 
 
+# -- J019: learner state mutated from a FleetStatusServer hook ---------------
+
+def test_j019_fires_on_state_mutation_in_ctl_hook():
+    # the anti-pattern the rule exists for: the ctl hook applies the
+    # weight copy on the status-server thread, racing the hot loop
+    assert fires("""
+        class Trainer:
+            def _serve(self):
+                self._fleet_status = FleetStatusServer(
+                    comms, self.fleet, ctl_fn=self._on_ctl)
+
+            def _on_ctl(self, cmd):
+                self.train_state = self._load(cmd["path"])
+                return {"accepted": True}
+        """, "J019")
+    # calling a trainer-thread applier from the hook is the same race
+    assert fires("""
+        class Trainer:
+            def _serve(self):
+                self._fleet_status = FleetStatusServer(
+                    comms, self.fleet, ctl_fn=self._on_ctl)
+
+            def _on_ctl(self, cmd):
+                self.restore_weights(cmd["path"])
+                return {"accepted": True}
+        """, "J019")
+    # one level of same-class delegation is followed
+    assert fires("""
+        class Trainer:
+            def _serve(self):
+                self._fleet_status = FleetStatusServer(
+                    comms, self.fleet, snapshot_fn=self._snap)
+
+            def _snap(self):
+                return self._refresh()
+
+            def _refresh(self):
+                self.replay_state = self._rebuild()
+                return {}
+        """, "J019")
+    # lambda hooks are inspected inline
+    assert fires("""
+        class Trainer:
+            def _serve(self):
+                self._fleet_status = FleetStatusServer(
+                    comms, self.fleet,
+                    ctl_fn=lambda cmd: self.apply_hparams(cmd))
+        """, "J019")
+
+
+def test_j019_silent_on_enqueue_and_drain_pattern():
+    # the PR 14 contract: the hook ENQUEUES only; the trainer thread
+    # drains on its health tick — reads and queue puts are fine
+    assert not fires("""
+        class Trainer:
+            def _serve(self):
+                self._fleet_status = FleetStatusServer(
+                    comms, self.fleet, ctl_fn=self._enqueue,
+                    metrics_fn=self._metrics, snapshot_fn=self._snap)
+
+            def _enqueue(self, cmd):
+                try:
+                    self._ctl_queue.put_nowait(dict(cmd))
+                except Exception:
+                    return {"accepted": False}
+                return {"accepted": True, "pending": self._ctl_queue.qsize()}
+
+            def _metrics(self):
+                return render(gauges=dict(steps=self.steps_rate.total))
+
+            def _snap(self):
+                snap = self.fleet.snapshot()
+                snap["metrics"]["learner_epoch"] = self.learner_epoch
+                return snap
+        """, "J019")
+    # state mutation on the TRAINER thread (no hook involvement) is the
+    # correct half of the pattern, not a finding
+    assert not fires("""
+        class Trainer:
+            def _drain(self, steps):
+                cmd = self._ctl_queue.get_nowait()
+                self.train_state = self._load(cmd["path"])
+        """, "J019")
+
+
 # -- CLI --------------------------------------------------------------------
 
 def _write(tmp_path, name, content):
