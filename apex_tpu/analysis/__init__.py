@@ -1,13 +1,25 @@
-"""apexlint — JAX/TPU-aware static analysis for the apex-tpu tree.
+"""apexlint — JAX/TPU-aware WHOLE-PROGRAM static analysis for apex-tpu.
 
 An AST-based rule engine for the hazard classes no generic linter sees:
 un-donated jit step buffers (J001), host syncs inside compiled code (J002),
 Python control flow on traced values (J003), PRNG key reuse (J004),
 jit-in-loop retracing (J005), fork-after-thread deadlocks (C001), leaked
 ZMQ sockets (C002), and shared-memory segments that violate the
-creator-owns-unlink contract (C003/C004).
+creator-owns-unlink contract (C003/C004) — through the protocol family
+that spans modules: donated-buffer reads after dispatch (J020), shard-band
+arithmetic outside the tenancy helpers (J021), hand-built epoch/version
+fence tuples (J022), and cross-module thread-affinity races (C006).
 
-Run it: ``python -m apex_tpu.analysis apex_tpu/`` (or ``scripts/lint.sh``).
+Per-file rules see a :class:`ModuleContext`; a tree run additionally
+parses everything ONCE into a :class:`~apex_tpu.analysis.graph.
+ProjectContext` (import/symbol graph, cross-module call graph, and the
+light dataflow layer in :mod:`~apex_tpu.analysis.dataflow`) attached as
+``ctx.project``, so cross-module rules hold invariants no single file
+can.
+
+Run it: ``python -m apex_tpu.analysis apex_tpu/`` (or ``scripts/lint.sh``;
+``--changed-only`` lints just the git-diff set, ``--sarif`` writes the CI
+artifact, ``--explain RULE`` prints a rule's why + fix recipe).
 Suppress a deliberate pattern inline::
 
     q = float(np.max(scores))  # apexlint: disable=J002 -- host priority path
@@ -19,7 +31,10 @@ package is pure stdlib — importing it never touches JAX or the TPU.
 
 from apex_tpu.analysis.core import (Baseline, Finding, ModuleContext, Rule,
                                     all_rules, analyze_paths, analyze_source,
-                                    register)
+                                    catalog, catalog_markdown, register,
+                                    sarif_report)
+from apex_tpu.analysis.graph import ProjectContext
 
-__all__ = ["Baseline", "Finding", "ModuleContext", "Rule", "all_rules",
-           "analyze_paths", "analyze_source", "register"]
+__all__ = ["Baseline", "Finding", "ModuleContext", "ProjectContext", "Rule",
+           "all_rules", "analyze_paths", "analyze_source", "catalog",
+           "catalog_markdown", "register", "sarif_report"]

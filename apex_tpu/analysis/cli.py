@@ -3,6 +3,12 @@
 Exit codes: 0 clean (every finding suppressed or baselined), 1 findings
 (or, under ``--strict``, stale baseline entries), 2 usage errors.
 
+``--sarif PATH`` additionally writes the findings as a SARIF 2.1.0 log
+(the CI artifact); ``--explain RULE`` prints a rule's catalog entry
+(why + fix recipe — the same metadata the README table is generated
+from, via ``--catalog-md``); ``--changed-only`` lints just the git-diff
+file set while the whole-program context still spans the full tree.
+
 Configuration rides in ``[tool.apexlint]`` in pyproject.toml (paths,
 exclude, baseline, disable); Python 3.10 has no tomllib, so a minimal
 single-section reader handles the flat keys apexlint uses.
@@ -38,10 +44,50 @@ _SECTION_RE = re.compile(r"^\s*\[(?P<name>[^\]]+)\]\s*$")
 _KEY_RE = re.compile(r"^\s*(?P<key>[A-Za-z0-9_-]+)\s*=\s*(?P<val>.+)$")
 
 
+def _strip_comment(line: str) -> tuple[str, int]:
+    """``(text up to the first comment, bracket depth delta)`` — both
+    computed string-aware, so a ``#`` or ``[`` inside a quoted value
+    neither truncates the line nor derails the multi-line fold."""
+    out = []
+    depth = 0
+    quote = None
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if quote is not None:
+            if c == "\\":
+                out.append(line[i:i + 2])
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+            out.append(c)
+        elif c in "\"'":
+            quote = c
+            out.append(c)
+        elif c == "#":
+            break
+        else:
+            if c == "[":
+                depth += 1
+            elif c == "]":
+                depth -= 1
+            out.append(c)
+        i += 1
+    return "".join(out).rstrip(), depth
+
+
 def load_config(root: str | None) -> dict:
     """Flat ``[tool.apexlint]`` keys from pyproject.toml.  Values are
     strings or arrays of strings (whose literal syntax TOML shares with
-    Python); anything fancier is ignored."""
+    Python); anything fancier is ignored.
+
+    Multi-line arrays fold until their brackets balance, with comments
+    stripped PER PHYSICAL LINE before folding (a per-item ``# why``
+    comment inside the array used to truncate the folded buffer at its
+    first ``#`` and silently drop the whole key).  A value that still
+    fails to parse — or an array left unclosed at section end — is
+    reported loudly on stderr instead of vanishing."""
     cfg: dict = {}
     if root is None:
         return cfg
@@ -51,14 +97,22 @@ def load_config(root: str | None) -> dict:
             lines = fh.read().splitlines()
     except OSError:
         return cfg
+
+    def complain(key: str, why: str) -> None:
+        print(f"apexlint: [tool.apexlint] key {key!r} in {path} "
+              f"ignored: {why}", file=sys.stderr)
+
     in_section = False
     buf = ""
     key = None
+    depth = 0
     for line in lines:
         m = _SECTION_RE.match(line)
-        if m:
+        if m and (key is None or depth <= 0):
+            if key is not None:
+                complain(key, "unterminated value at section boundary")
             in_section = m.group("name").strip() == "tool.apexlint"
-            buf, key = "", None
+            buf, key, depth = "", None, 0
             continue
         if not in_section:
             continue
@@ -66,16 +120,21 @@ def load_config(root: str | None) -> dict:
             m = _KEY_RE.match(line)
             if not m:
                 continue
-            key, buf = m.group("key"), m.group("val")
+            key = m.group("key")
+            buf, depth = _strip_comment(m.group("val"))
         else:
-            buf += " " + line.strip()
-        if buf.count("[") > buf.count("]"):
+            folded, d = _strip_comment(line.strip())
+            buf += " " + folded
+            depth += d
+        if depth > 0:
             continue                      # multiline array: keep folding
         try:
-            cfg[key] = _ast.literal_eval(buf.split("#")[0].strip())
-        except (ValueError, SyntaxError):
-            pass
-        key, buf = None, ""
+            cfg[key] = _ast.literal_eval(buf.strip())
+        except (ValueError, SyntaxError) as e:
+            complain(key, f"unparsable value ({e})")
+        key, buf, depth = None, "", 0
+    if key is not None:
+        complain(key, "unterminated value at end of file")
     return cfg
 
 
@@ -103,7 +162,63 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to skip")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write findings as a SARIF 2.1.0 log "
+                        "(the CI artifact format)")
+    p.add_argument("--explain", default=None, metavar="RULE",
+                   help="print a rule's catalog entry (why + fix recipe) "
+                        "and exit; comma-separate ids, or 'all'")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only the git-diff file set (worktree + "
+                        "index vs HEAD, plus untracked); the "
+                        "whole-program context still spans the full tree")
+    p.add_argument("--catalog-md", action="store_true",
+                   help="print the rule catalog as a Markdown table "
+                        "(the README table's generation source) and exit")
     return p
+
+
+def explain(rule_ids: str, rules) -> int:
+    """``--explain``: the rule catalog, filtered to ``rule_ids``."""
+    from apex_tpu.analysis.core import catalog
+    entries = {e["id"]: e for e in catalog()}
+    wanted = (list(entries) if rule_ids.strip().lower() == "all"
+              else [r.strip() for r in rule_ids.split(",") if r.strip()])
+    unknown = [r for r in wanted if r not in entries]
+    if unknown:
+        print(f"apexlint: unknown rule id(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+    for i, rid in enumerate(wanted):
+        e = entries[rid]
+        if i:
+            print()
+        print(f"{e['id']}  {e['name']}")
+        print(f"  why: {e['why']}")
+        if e["fix"]:
+            print(f"  fix: {e['fix']}")
+        print(f"\n  {e['description']}")
+    return 0
+
+
+def changed_files(root: str) -> set[str] | None:
+    """Root-relative paths of files changed vs HEAD (worktree + index)
+    plus untracked files; None when git is unavailable or errors."""
+    import subprocess
+    out: set[str] = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        out |= {ln.strip().replace(os.sep, "/")
+                for ln in proc.stdout.splitlines() if ln.strip()}
+    return out
 
 
 def main(argv=None) -> int:
@@ -113,6 +228,12 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rid, rule in rules.items():
             print(f"{rid}  {rule.name}\n    {rule.description}")
+        return 0
+    if args.explain is not None:
+        return explain(args.explain, rules)
+    if args.catalog_md:
+        from apex_tpu.analysis.core import catalog_markdown
+        print(catalog_markdown(), end="")
         return 0
 
     root = find_project_root()
@@ -152,8 +273,21 @@ def main(argv=None) -> int:
     if args.no_baseline:
         baseline_path = None
 
+    only = None
+    if args.changed_only:
+        base = root or os.getcwd()
+        changed = changed_files(base)
+        if changed is None:
+            print("apexlint: --changed-only needs a git checkout "
+                  "(git diff failed)", file=sys.stderr)
+            return 2
+        only = {p for p in changed if p.endswith(".py")}
+        if not only:
+            print("apexlint: no changed python files")
+            return 0
+
     findings, suppressed = analyze_paths(paths, exclude=exclude,
-                                         rules=rules, root=root)
+                                         rules=rules, root=root, only=only)
 
     if args.write_baseline:
         if baseline_path is None:
@@ -172,6 +306,17 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     new, baselined, stale = baseline.partition(findings)
+    if only is not None:
+        # a partial run can only judge staleness for the files it linted
+        stale = [e for e in stale if e["path"] in only]
+
+    if args.sarif:
+        from apex_tpu.analysis.core import sarif_report
+        report = sarif_report(new, baselined, suppressed, rules=rules,
+                              root=root)
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
 
     if args.as_json:
         print(json.dumps({

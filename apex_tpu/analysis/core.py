@@ -30,6 +30,7 @@ import json
 import os
 import re
 import tokenize
+from collections import deque
 from dataclasses import dataclass
 
 # -- findings ---------------------------------------------------------------
@@ -64,12 +65,18 @@ class Finding:
 
 
 class Rule:
-    """Base class; subclasses set ``id``/``name``/``description`` and
-    implement :meth:`check`."""
+    """Base class; subclasses set ``id``/``name``/``description`` (and
+    the catalog one-liners ``why``/``fix``) and implement :meth:`check`.
+
+    ``why`` is the one-line hazard statement and ``fix`` the one-line
+    recipe — the metadata ``--explain``/``--catalog-md`` print and the
+    README rule table is generated from, so docs and CLI cannot drift."""
 
     id: str = ""
     name: str = ""
     description: str = ""
+    why: str = ""
+    fix: str = ""
 
     def check(self, ctx: "ModuleContext") -> list[Finding]:
         raise NotImplementedError
@@ -88,8 +95,32 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 def all_rules() -> dict[str, Rule]:
     # rule modules register on import; import here to avoid a cycle
-    from apex_tpu.analysis import rules_concurrency, rules_jax  # noqa: F401
+    from apex_tpu.analysis import (rules_concurrency,  # noqa: F401
+                                   rules_jax, rules_protocol)
     return dict(sorted(_REGISTRY.items()))
+
+
+def catalog() -> list[dict]:
+    """The rule catalog ``--explain``/``--catalog-md`` and the README
+    table render from: one entry per rule, why/fix falling back to the
+    description's first sentence when a rule predates the metadata."""
+    out = []
+    for rid, rule in all_rules().items():
+        why = rule.why or rule.description.split(". ")[0].strip()
+        out.append({"id": rid, "name": rule.name, "why": why,
+                    "fix": rule.fix, "description": rule.description})
+    return out
+
+
+def catalog_markdown() -> str:
+    """Markdown rule table (README's generated block — regenerate with
+    ``python -m apex_tpu.analysis --catalog-md``)."""
+    lines = ["| Rule | Title | Why | Fix |", "|---|---|---|---|"]
+    for e in catalog():
+        row = [e["id"], f"`{e['name']}`", e["why"], e["fix"] or "—"]
+        lines.append("| " + " | ".join(c.replace("|", "\\|")
+                                       for c in row) + " |")
+    return "\n".join(lines) + "\n"
 
 
 # -- jit detection helpers --------------------------------------------------
@@ -143,15 +174,31 @@ class ModuleContext:
     def __init__(self, path: str, source: str):
         self.path = path
         self.source = source
+        #: the whole-program ProjectContext when this module was analyzed
+        #: as part of a tree walk; None for lone-snippet analysis — every
+        #: rule must degrade to per-file behavior without it
+        self.project = None
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
+        # one BFS (ast.walk order) builds every navigation index: parent
+        # links, the per-type node lists `nodes()` serves, the O(1)
+        # enclosing-function map, and the function list — 28 rules walk
+        # this tree; they must not each re-walk it from the root
         self.parents: dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
-            for child in ast.iter_child_nodes(parent):
-                self.parents[child] = parent
-        self.functions = [n for n in ast.walk(self.tree)
-                          if isinstance(n, (ast.FunctionDef,
-                                            ast.AsyncFunctionDef))]
+        self._by_type: dict[type, list] = {}
+        self._encl_fn: dict[ast.AST, ast.AST | None] = {}
+        self.functions: list = []
+        todo = deque([(self.tree, None)])
+        while todo:
+            node, fn = todo.popleft()
+            self._by_type.setdefault(type(node), []).append(node)
+            self._encl_fn[node] = fn
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(node)
+                fn = node
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                todo.append((child, fn))
         self.jitted = self._collect_jitted()
         self._inline_supp, self._standalone_supp = \
             _collect_suppressions(source)
@@ -165,10 +212,24 @@ class ModuleContext:
             n = self.parents.get(n)
 
     def enclosing_function(self, node: ast.AST):
-        for a in self.ancestors(node):
-            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return a
-        return None
+        try:
+            return self._encl_fn[node]
+        except KeyError:        # node not from this tree: ancestor scan
+            for a in self.ancestors(node):
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return a
+            return None
+
+    def nodes(self, *types: type) -> list:
+        """All nodes of the EXACT given AST types, in ast.walk order —
+        the index-backed replacement for ``ast.walk(ctx.tree)`` +
+        isinstance filtering (list subclasses explicitly)."""
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        return out
 
     def enclosing_class(self, node: ast.AST):
         for a in self.ancestors(node):
@@ -300,17 +361,23 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", "_build", ".eggs", "build", "dist"}
 
 def analyze_source(source: str, path: str = "<string>",
                    rules: dict[str, Rule] | None = None,
-                   respect_suppressions: bool = True):
+                   respect_suppressions: bool = True, project=None):
     """Analyze one module.  Returns ``(findings, suppressed)`` — both lists
-    of :class:`Finding`, split by inline ``disable`` comments."""
+    of :class:`Finding`, split by inline ``disable`` comments.  ``project``
+    (a :class:`~apex_tpu.analysis.graph.ProjectContext`) attaches the
+    whole-program view; its pre-parsed ModuleContext is reused when it
+    holds one for ``path``."""
     rules = all_rules() if rules is None else rules
     try:
-        ctx = ModuleContext(path, source)
+        ctx = (project.module_ctx(path)
+               if project is not None else None) or ModuleContext(path,
+                                                                  source)
     except (SyntaxError, ValueError) as e:
         line = getattr(e, "lineno", 1) or 1
         return [Finding(rule=PARSE_ERROR, path=path, line=line, col=0,
                         message=f"file does not parse: {e.msg}"
                         if isinstance(e, SyntaxError) else str(e))], []
+    ctx.project = project
     findings: list[Finding] = []
     for rule in rules.values():
         findings.extend(rule.check(ctx))
@@ -347,24 +414,39 @@ def iter_python_files(paths, exclude=()):
 
 
 def analyze_paths(paths, exclude=(), rules: dict[str, Rule] | None = None,
-                  root: str | None = None):
+                  root: str | None = None, only=None):
     """Analyze every .py file under ``paths``.  Finding paths are made
     relative to ``root`` (default: cwd) so baselines are machine-portable.
-    Returns ``(findings, suppressed)``."""
+
+    The whole tree is parsed ONCE into a
+    :class:`~apex_tpu.analysis.graph.ProjectContext` before any rule
+    runs, so cross-module rules (J020+, C006) see every module's import/
+    call graph.  ``only`` (an iterable of root-relative ``/``-separated
+    paths) restricts which files get REPORTED — the project context
+    still spans the full tree, so a ``--changed-only`` run keeps the
+    whole-program rules accurate.  Returns ``(findings, suppressed)``."""
+    from apex_tpu.analysis.graph import ProjectContext
     root = os.path.abspath(root or os.getcwd())
+    only = None if only is None else {p.replace(os.sep, "/") for p in only}
     findings: list[Finding] = []
     suppressed: list[Finding] = []
+    sources: dict[str, str] = {}
     for file in iter_python_files(paths, exclude):
         rel = os.path.relpath(os.path.abspath(file), root)
         rel = rel.replace(os.sep, "/")
         try:
             with open(file, "r", encoding="utf-8", errors="replace") as fh:
-                source = fh.read()
+                sources[rel] = fh.read()
         except OSError as e:
-            findings.append(Finding(rule=PARSE_ERROR, path=rel, line=1,
-                                    col=0, message=f"unreadable: {e}"))
+            if only is None or rel in only:
+                findings.append(Finding(rule=PARSE_ERROR, path=rel, line=1,
+                                        col=0, message=f"unreadable: {e}"))
+    project = ProjectContext(sources)
+    for rel, source in sources.items():
+        if only is not None and rel not in only:
             continue
-        got, supp = analyze_source(source, path=rel, rules=rules)
+        got, supp = analyze_source(source, path=rel, rules=rules,
+                                   project=project)
         findings.extend(got)
         suppressed.extend(supp)
     return findings, suppressed
@@ -429,3 +511,56 @@ class Baseline:
         stale = [{"rule": r, "path": p, "code": c, "count": n}
                  for (r, p, c), n in sorted(remaining.items()) if n > 0]
         return new, matched, stale
+
+
+# -- SARIF ------------------------------------------------------------------
+
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+SARIF_VERSION = "2.1.0"
+
+
+def sarif_report(new, baselined=(), suppressed=(),
+                 rules: dict[str, Rule] | None = None,
+                 root: str | None = None) -> dict:
+    """Findings as a SARIF 2.1.0 log (the CI gate's artifact format).
+
+    New findings are level ``error`` (they fail the run); baselined and
+    inline-suppressed findings ride along as suppressed results (kinds
+    ``external`` / ``inSource``) so the artifact is the COMPLETE picture,
+    not just the failing slice."""
+    rules = all_rules() if rules is None else rules
+    driver_rules = []
+    for rid, rule in sorted(rules.items()):
+        entry = {"id": rid, "name": rule.name or rid,
+                 "shortDescription": {"text": rule.name or rid},
+                 "fullDescription": {"text": rule.description}}
+        if rule.why or rule.fix:
+            entry["help"] = {"text": f"why: {rule.why}\nfix: {rule.fix}"}
+        driver_rules.append(entry)
+
+    def result(f: Finding, level: str, suppression: str | None):
+        r = {"ruleId": f.rule, "level": level,
+             "message": {"text": f.message},
+             "locations": [{"physicalLocation": {
+                 "artifactLocation": {"uri": f.path.replace(os.sep, "/"),
+                                      "uriBaseId": "SRCROOT"},
+                 "region": {"startLine": max(1, f.line),
+                            "startColumn": f.col + 1}}}]}
+        if suppression is not None:
+            r["suppressions"] = [{"kind": suppression}]
+        return r
+
+    results = ([result(f, "error", None) for f in new]
+               + [result(f, "note", "external") for f in baselined]
+               + [result(f, "note", "inSource") for f in suppressed])
+    run = {"tool": {"driver": {"name": "apexlint",
+                               "informationUri":
+                                   "https://github.com/apex-tpu/apex-tpu",
+                               "rules": driver_rules}},
+           "results": results}
+    if root:
+        uri = "file://" + os.path.abspath(root).replace(os.sep, "/")
+        run["originalUriBaseIds"] = {"SRCROOT": {"uri": uri.rstrip("/")
+                                                 + "/"}}
+    return {"$schema": SARIF_SCHEMA, "version": SARIF_VERSION,
+            "runs": [run]}

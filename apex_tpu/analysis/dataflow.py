@@ -1,0 +1,402 @@
+"""Light intra-procedural dataflow: reaching defs + donation tracking.
+
+Two analyses, both deliberately linear (no fixed-point CFG — statements
+in source order, branches merged by union), because the hazards they
+serve are straight-line epilogue bugs, not loop-carried lattice puzzles:
+
+* :func:`reaching_defs` — for every local-name load in a function, the
+  set of assignment statements that may reach it.  Branches contribute
+  their defs without killing the pre-branch ones (may-reach, not
+  must-reach), which is the safe direction for a linter.
+
+* donation tracking — :func:`donated_callables` finds every callable in
+  a module bound to ``jax.jit(..., donate_argnums=...)`` (direct
+  assignment, ``@partial(jax.jit, donate_argnums=...)`` decoration, or
+  assignment from a same-module/imported factory that returns one), and
+  :func:`donation_hazards` walks each function for call sites of those
+  callables where a donated argument buffer is READ again after the
+  dispatch that consumed it.  XLA invalidates a donated buffer at
+  dispatch: the post-call read returns garbage (or a deleted-buffer
+  error), and only the rebind-from-results epilogue (the ``FusedStep.
+  dispatch`` discipline) is safe.
+
+Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from apex_tpu.analysis.core import is_jit_expr
+
+__all__ = ["DonatedCallable", "DonationHazard", "donated_callables",
+           "donation_hazards", "expr_path", "reaching_defs"]
+
+
+def expr_path(node: ast.AST) -> str | None:
+    """Dotted spelling of a name/attribute chain (``train_state``,
+    ``self.ingested_dev``, ``eng.carry``) — the alias key donation
+    tracking matches on.  None for anything with a call/subscript in
+    the chain (those are fresh values, not aliases)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# -- reaching definitions ----------------------------------------------------
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def reaching_defs(fn: ast.AST) -> dict[ast.Name, set[ast.stmt]]:
+    """Map every ``Name`` LOAD in ``fn`` to the set of statements whose
+    assignment may reach it (function parameters reach as a def-site of
+    the ``arguments`` node's owning function).  Nested function bodies
+    are skipped — their loads close over a different frame."""
+    result: dict[ast.Name, set[ast.stmt]] = {}
+    params = {a.arg for a in _all_args(fn)}
+    env: dict[str, set] = {p: {fn} for p in params}
+
+    def visit_block(stmts, env):
+        for stmt in stmts:
+            # loads in this statement see the CURRENT env
+            for n in _own_nodes(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    if n.id in env:
+                        result[n] = set(env[n.id])
+            if isinstance(stmt, (ast.If,)):
+                e1 = {k: set(v) for k, v in env.items()}
+                e2 = {k: set(v) for k, v in env.items()}
+                visit_block(stmt.body, e1)
+                visit_block(stmt.orelse, e2)
+                for k in set(e1) | set(e2):
+                    env[k] = (e1.get(k, set()) | e2.get(k, set())
+                              | env.get(k, set()))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                for name in _assigned_names(stmt):
+                    env.setdefault(name, set()).add(stmt)
+                body_env = {k: set(v) for k, v in env.items()}
+                visit_block(stmt.body, body_env)
+                visit_block(list(stmt.orelse), body_env)
+                for k in body_env:
+                    env[k] = body_env.get(k, set()) | env.get(k, set())
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for name in _assigned_names(stmt):
+                    env[name] = {stmt}
+                visit_block(stmt.body, env)
+            elif isinstance(stmt, ast.Try):
+                visit_block(stmt.body, env)
+                for h in stmt.handlers:
+                    visit_block(h.body, env)
+                visit_block(stmt.orelse, env)
+                visit_block(stmt.finalbody, env)
+            else:
+                for name in _assigned_names(stmt):
+                    env[name] = {stmt}
+        return env
+
+    visit_block(list(fn.body), env)
+    return result
+
+
+def _all_args(fn: ast.AST):
+    a = fn.args
+    return (list(a.posonlyargs) + list(a.args)
+            + ([a.vararg] if a.vararg else [])
+            + list(a.kwonlyargs) + ([a.kwarg] if a.kwarg else []))
+
+
+def _own_nodes(stmt: ast.stmt):
+    """Nodes of ``stmt`` excluding nested statement bodies and nested
+    function/class definitions (block statements recurse explicitly)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    skip_blocks = isinstance(stmt, (ast.If, ast.For, ast.AsyncFor,
+                                    ast.While, ast.With, ast.AsyncWith,
+                                    ast.Try))
+    if not skip_blocks:
+        yield from ast.walk(stmt)
+        return
+    # header expressions only (test/iter/items); bodies recurse elsewhere
+    for field in ("test", "iter", "target"):
+        sub = getattr(stmt, field, None)
+        if sub is not None:
+            yield from ast.walk(sub)
+    for item in getattr(stmt, "items", ()):
+        yield from ast.walk(item.context_expr)
+
+
+# -- donation tracking -------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DonatedCallable:
+    """A callable whose dispatch consumes (donates) argument buffers."""
+
+    key: str                    # call spelling: "step" / "self._jit"
+    positions: tuple[int, ...]  # donated positional indices
+    node: ast.AST               # where the donation was declared
+
+
+@dataclass(frozen=True)
+class DonationHazard:
+    """One post-dispatch read of a donated buffer."""
+
+    call: ast.Call              # the consuming dispatch
+    arg_path: str               # the donated argument's spelling
+    read: ast.AST               # the offending read (call itself when the
+                                # re-read is the next loop iteration)
+    loop_carried: bool          # True: undonated re-dispatch in a loop
+
+
+def _donation_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated positions of a ``jax.jit(fn, donate_argnums=...)`` call
+    (None when the call is not a donating jit)."""
+    if not (isinstance(call, ast.Call) and is_jit_expr(call.func)):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = tuple(e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+            return out or None
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        return None
+    return None
+
+
+def _decorator_positions(fn: ast.AST) -> tuple[int, ...] | None:
+    """``@partial(jax.jit, donate_argnums=...)`` decoration."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and is_jit_expr(dec):
+            got = _donation_positions_from_partial(dec)
+            if got:
+                return got
+    return None
+
+
+def _donation_positions_from_partial(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return ()
+
+
+def donated_callables(ctx) -> dict[str, DonatedCallable]:
+    """Every call spelling in ``ctx`` bound to a donating jit.
+
+    Three binding shapes::
+
+        self._jit = jax.jit(self._dispatch, donate_argnums=(0, 1))
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(ts, batch): ...
+        self._train = self._make_train()     # factory returns a donating jit
+
+    Factories resolve same-module by name; with a :class:`ProjectContext`
+    attached (``ctx.project``) an imported factory resolves cross-module
+    too."""
+    out: dict[str, DonatedCallable] = {}
+    factories: dict[str, tuple[int, ...]] = {}
+    for fn in ctx.functions:
+        pos = _decorator_positions(fn)
+        if pos:
+            out[fn.name] = DonatedCallable(fn.name, pos, fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                got = _donation_positions(node.value) \
+                    if isinstance(node.value, ast.Call) else None
+                if got:
+                    factories[fn.name] = got
+    project = getattr(ctx, "project", None)
+    if project is not None:
+        info = project.modules.get(ctx.path)
+        if info is not None:
+            for alias, (kind, target) in info.aliases.items():
+                if kind != "symbol":
+                    continue
+                node = project.definitions.get(target)
+                if node is None or not isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and \
+                            isinstance(sub.value, ast.Call):
+                        got = _donation_positions(sub.value)
+                        if got:
+                            factories.setdefault(alias, got)
+    for node in ctx.nodes(ast.Assign):
+        if not isinstance(node.value, ast.Call):
+            continue
+        pos = _donation_positions(node.value)
+        if pos is None:
+            # assignment from a known donated-jit FACTORY call
+            callee = node.value.func
+            base = (callee.id if isinstance(callee, ast.Name)
+                    else callee.attr if isinstance(callee, ast.Attribute)
+                    else None)
+            pos = factories.get(base or "")
+        if not pos:
+            continue
+        for t in node.targets:
+            path = expr_path(t)
+            if path is not None:
+                out[path] = DonatedCallable(path, tuple(pos), node.value)
+    return out
+
+
+def _stmt_sequence(fn: ast.AST) -> list[ast.stmt]:
+    """All statements of ``fn`` in source order, excluding nested defs."""
+    out: list[ast.stmt] = []
+
+    def walk(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            out.append(s)
+            for field in ("body", "orelse", "finalbody"):
+                walk(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                walk(h.body)
+
+    walk(list(fn.body))
+    return out
+
+
+def _path_events(stmt: ast.stmt, paths: set[str]):
+    """``path -> (loads, stores)`` touches of tracked paths in one
+    statement's OWN expressions (nested block bodies are separate
+    statements in the flattened sequence)."""
+    out: dict[str, tuple[list, list]] = {}
+    for node in _own_nodes(stmt):
+        p = expr_path(node)
+        if p not in paths:
+            continue
+        loads, stores = out.setdefault(p, ([], []))
+        is_store = (hasattr(node, "ctx")
+                    and isinstance(node.ctx, ast.Store))
+        (stores if is_store else loads).append(node)
+    return out
+
+
+def donation_hazards(ctx) -> list[DonationHazard]:
+    """Post-dispatch reads of donated buffers, per function."""
+    donated = donated_callables(ctx)
+    if not donated:
+        return []
+    hazards: list[DonationHazard] = []
+    for fn in ctx.functions:
+        seq = _stmt_sequence(fn)
+        for i, stmt in enumerate(seq):
+            for call in _own_nodes(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                key = expr_path(call.func)
+                dc = donated.get(key or "")
+                if dc is None:
+                    continue
+                arg_paths: dict[str, int] = {}
+                for pos in dc.positions:
+                    if pos < len(call.args):
+                        p = expr_path(call.args[pos])
+                        if p is not None:
+                            arg_paths[p] = pos
+                if not arg_paths:
+                    continue
+                # the rebind epilogue: targets of the SAME statement
+                rebound: set[str] = set()
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        for n in (ast.walk(t)
+                                  if isinstance(t, (ast.Tuple, ast.List))
+                                  else [t]):
+                            p = expr_path(n)
+                            if p is not None:
+                                rebound.add(p)
+                live = set(arg_paths) - rebound
+                if not live:
+                    continue
+                hazards.extend(self_reads_after(
+                    seq, i, stmt, call, live))
+                # loop-carried: an undonated re-dispatch next iteration
+                loop = _enclosing_loop(ctx, call, fn)
+                if loop is not None:
+                    for p in sorted(live):
+                        if not _stored_in(loop, p):
+                            hazards.append(DonationHazard(
+                                call, p, call, loop_carried=True))
+    return hazards
+
+
+def self_reads_after(seq, i, stmt, call, live: set[str]):
+    """Reads of still-donated paths in statements after the dispatch.
+    A statement that both loads and stores a path (``x = f(x)``) reads
+    first at runtime, so the load wins."""
+    out: list[DonationHazard] = []
+    pending = set(live)
+    for later in seq[i + 1:]:
+        if not pending:
+            break
+        for p, (loads, stores) in _path_events(later, pending).items():
+            if loads:
+                out.append(DonationHazard(call, p, loads[0],
+                                          loop_carried=False))
+            pending.discard(p)      # either flagged or rebound: done
+    return out
+
+
+def _enclosing_loop(ctx, node: ast.AST, fn: ast.AST):
+    for a in ctx.ancestors(node):
+        if a is fn:
+            return None
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return a
+    return None
+
+
+def _stored_in(block: ast.AST, path: str) -> bool:
+    for n in ast.walk(block):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                for sub in (ast.walk(t)
+                            if isinstance(t, (ast.Tuple, ast.List))
+                            else [t]):
+                    if expr_path(sub) == path:
+                        return True
+    return False

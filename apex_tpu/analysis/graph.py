@@ -1,0 +1,259 @@
+"""Whole-program layer: import/symbol graph + cross-module call graph.
+
+:class:`ProjectContext` parses the configured tree ONCE and derives the
+facts no single :class:`~apex_tpu.analysis.core.ModuleContext` can hold:
+which module a bare or dotted callee resolves to, which functions are
+reachable from a ``threading.Thread(target=...)`` spawn anywhere in the
+project, and where a symbol imported under an alias actually lives.  The
+per-file rules run unchanged — ``analyze_paths`` attaches the project to
+every ``ModuleContext`` as ``ctx.project``, and a rule that needs the
+cross-module view reads it (``None`` when analyzing a lone snippet, so
+every rule must degrade to per-file behavior).
+
+Resolution is deliberately name-based and conservative (static analysis
+cannot see through dynamic dispatch): a call edge exists only when the
+callee resolves through a top-level def, a ``self.<method>`` of the
+enclosing class, or an import alias to another project module.  Missing
+edges make whole-program rules QUIETER, never noisier — the same
+fail-silent bias as the jitted-scope heuristics in ``core.py``.
+
+Pure stdlib, like the rest of apexlint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = ["ModuleInfo", "ProjectContext", "modname_for"]
+
+
+def modname_for(rel_path: str) -> str:
+    """Dotted module name for a root-relative ``.py`` path
+    (``apex_tpu/serving/shard.py`` -> ``apex_tpu.serving.shard``;
+    package ``__init__.py`` collapses to the package name)."""
+    p = rel_path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    mod = p.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _dotted(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` attribute chain as ``["a", "b", "c"]`` (None when the
+    expression is not a pure name/attribute chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """One module's resolution facts: import aliases and top-level defs."""
+
+    def __init__(self, path: str, modname: str, ctx):
+        self.path = path
+        self.modname = modname
+        self.ctx = ctx                       # the shared ModuleContext
+        #: alias -> ("module", dotted modname) | ("symbol", dotted qualname)
+        self.aliases: dict[str, tuple[str, str]] = {}
+        #: top-level function/class name -> AST node
+        self.toplevel: dict[str, ast.AST] = {}
+        #: class name -> {method name -> FunctionDef}
+        self.classes: dict[str, dict[str, ast.AST]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        tree = self.ctx.tree
+        # relative imports anchor at the containing package: one level up
+        # for a plain module, the module itself for a package __init__
+        parts = self.modname.split(".")
+        is_pkg = self.path.replace(os.sep, "/").endswith("/__init__.py")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    self.aliases[alias] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    drop = node.level - (1 if is_pkg else 0)
+                    anchor = parts[: len(parts) - drop] if drop else parts
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    self.aliases[alias] = ("symbol",
+                                           f"{base}.{a.name}" if base
+                                           else a.name)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.toplevel[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.toplevel[node.name] = node
+                self.classes[node.name] = {
+                    n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+class ProjectContext:
+    """The whole-tree view: one parse of every file, plus derived graphs.
+
+    ``sources`` maps root-relative ``/``-separated paths to file text.
+    Unparseable files are skipped here (``analyze_source`` still reports
+    them as E001 on its own pass).
+    """
+
+    def __init__(self, sources: dict[str, str]):
+        from apex_tpu.analysis.core import ModuleContext
+        self.modules: dict[str, ModuleInfo] = {}          # rel path -> info
+        self.by_modname: dict[str, ModuleInfo] = {}
+        for path, source in sorted(sources.items()):
+            try:
+                ctx = ModuleContext(path, source)
+            except (SyntaxError, ValueError):
+                continue
+            info = ModuleInfo(path, modname_for(path), ctx)
+            self.modules[path] = info
+            self.by_modname[info.modname] = info
+        #: qualified def name ("mod.f" / "mod.Cls.m") -> AST node
+        self.definitions: dict[str, ast.AST] = {}
+        for info in self.modules.values():
+            for name, node in info.toplevel.items():
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.definitions[f"{info.modname}.{name}"] = node
+            for cls, methods in info.classes.items():
+                for m, node in methods.items():
+                    self.definitions[f"{info.modname}.{cls}.{m}"] = node
+        self.import_graph = self._build_import_graph()
+        self.call_graph = self._build_call_graph()
+        self.thread_targets = self._collect_thread_targets()
+        self.thread_reachable = self._closure(self.thread_targets)
+
+    # -- lookup ------------------------------------------------------------
+
+    def module_ctx(self, path: str):
+        info = self.modules.get(path.replace(os.sep, "/"))
+        return info.ctx if info is not None else None
+
+    def qualname_of(self, info: ModuleInfo, fn: ast.AST) -> str:
+        """Qualified name of a def inside ``info`` (class methods get the
+        ``mod.Cls.m`` spelling; nested defs fold into their parent's)."""
+        ctx = info.ctx
+        parts = [getattr(fn, "name", "<module>")]
+        for a in ctx.ancestors(fn):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                parts.append(a.name)
+        return ".".join([info.modname] + list(reversed(parts)))
+
+    # -- graphs ------------------------------------------------------------
+
+    def _build_import_graph(self) -> dict[str, set[str]]:
+        graph: dict[str, set[str]] = {}
+        for info in self.modules.values():
+            deps: set[str] = set()
+            for _, (kind, target) in info.aliases.items():
+                if kind == "module":
+                    if target in self.by_modname:
+                        deps.add(target)
+                else:
+                    # "symbol": the owning module is the dotted prefix
+                    owner = target.rsplit(".", 1)[0]
+                    if owner in self.by_modname:
+                        deps.add(owner)
+                    elif target in self.by_modname:      # from pkg import mod
+                        deps.add(target)
+            graph[info.modname] = deps
+        return graph
+
+    def resolve_callable(self, info: ModuleInfo, node: ast.AST,
+                         enclosing_class: ast.ClassDef | None = None
+                         ) -> str | None:
+        """Qualified name a callee/target expression resolves to, or None.
+
+        Handles: top-level names, ``self.m`` within a class, import
+        aliases (``from m import f`` and ``import m as x; x.f``), and
+        dotted chains through a module alias."""
+        chain = _dotted(node)
+        if not chain:
+            return None
+        head, rest = chain[0], chain[1:]
+        if head == "self" and enclosing_class is not None:
+            if len(rest) == 1 and rest[0] in \
+                    info.classes.get(enclosing_class.name, {}):
+                return f"{info.modname}.{enclosing_class.name}.{rest[0]}"
+            return None
+        if not rest:
+            if head in info.toplevel:
+                return f"{info.modname}.{head}"
+            alias = info.aliases.get(head)
+            if alias is not None:
+                kind, target = alias
+                if kind == "symbol":
+                    return target
+            return None
+        alias = info.aliases.get(head)
+        if alias is None:
+            return None
+        kind, target = alias
+        qual = f"{target}.{'.'.join(rest)}"
+        # prefer a resolution that lands on a known def; fall back to the
+        # raw join so rules can still match by module prefix
+        return qual
+
+    def _build_call_graph(self) -> dict[str, set[str]]:
+        graph: dict[str, set[str]] = {}
+        for info in self.modules.values():
+            ctx = info.ctx
+            for node in ctx.nodes(ast.Call):
+                fn = ctx.enclosing_function(node)
+                caller = (self.qualname_of(info, fn) if fn is not None
+                          else f"{info.modname}.<module>")
+                cls = ctx.enclosing_class(node)
+                callee = self.resolve_callable(info, node.func, cls)
+                if callee is None:
+                    continue
+                graph.setdefault(caller, set()).add(callee)
+        return graph
+
+    def _collect_thread_targets(self) -> set[str]:
+        """Qualified names handed to ``Thread(target=...)`` anywhere."""
+        targets: set[str] = set()
+        for info in self.modules.values():
+            ctx = info.ctx
+            for node in ctx.nodes(ast.Call):
+                f = node.func
+                basename = (f.id if isinstance(f, ast.Name)
+                            else f.attr if isinstance(f, ast.Attribute)
+                            else None)
+                if basename != "Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    cls = ctx.enclosing_class(node)
+                    qual = self.resolve_callable(info, kw.value, cls)
+                    if qual is not None:
+                        targets.add(qual)
+        return targets
+
+    def _closure(self, roots: set[str]) -> set[str]:
+        """Call-graph closure: everything reachable from ``roots``."""
+        seen: set[str] = set()
+        stack = [r for r in roots]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(self.call_graph.get(q, ()))
+        return seen

@@ -56,6 +56,10 @@ def _stmt_order(node: ast.AST) -> tuple[int, int]:
 class ForkAfterThread(Rule):
     id = "C001"
     name = "fork-after-thread"
+    why = ("fork after threads are live copies their lock state into the "
+           "child and deadlocks it.")
+    fix = ("Set the spawn/forkserver start method, or start processes before "
+           "any thread.")
     description = ("multiprocessing.Process started after a threading."
                    "Thread is live, with no spawn/forkserver start method "
                    "in sight: fork copies the lock state of invisible "
@@ -64,9 +68,7 @@ class ForkAfterThread(Rule):
     _SAFE_METHODS = ("spawn", "forkserver")
 
     def _file_pins_safe_start(self, ctx: ModuleContext) -> bool:
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             if _callee_basename(node) in ("get_context",
                                           "set_start_method"):
                 if node.args and isinstance(node.args[0], ast.Constant) \
@@ -265,6 +267,10 @@ class _LifecycleRule(Rule):
 class ZmqSocketLeak(_LifecycleRule):
     id = "C002"
     name = "zmq-socket-leak"
+    why = ("An unclosed zmq socket or context leaks its fd and can hang "
+           "interpreter shutdown.")
+    fix = ("close(linger=0)/term in a finally block, or tie the socket to the "
+           "owner's close().")
     description = ("zmq socket/context created without close()/term() on "
                    "an exit path: lingering sockets hold ports and peer "
                    "connections past role death (transport.py closes every "
@@ -303,6 +309,9 @@ def _is_shm_ctor(node: ast.Call) -> bool:
 class ShmLifecycle(_LifecycleRule):
     id = "C003"
     name = "shm-lifecycle"
+    why = ("A created shared-memory segment with no matching unlink leaks "
+           "/dev/shm until reboot.")
+    fix = "The creator unlinks in its cleanup path; attachers only close()."
     description = ("shared-memory segment created (create=True) without "
                    "close()/unlink() in its owning scope: the segment "
                    "outlives the process in /dev/shm (ring.py contract: "
@@ -323,6 +332,10 @@ class ShmLifecycle(_LifecycleRule):
 class ShmForeignUnlink(Rule):
     id = "C004"
     name = "shm-foreign-unlink"
+    why = ("Unlinking a segment this module only attached destroys it under "
+           "its real owner.")
+    fix = ("Only the creating module unlinks; attachers close() and leave "
+           "lifecycle to the owner.")
     description = ("unlink() on a shared-memory segment this scope only "
                    "OPENED (create=False): unlinking from a non-creator "
                    "yanks the segment out from under the owner and every "
@@ -332,8 +345,7 @@ class ShmForeignUnlink(Rule):
         out = []
         # class-level map: attr -> created-here?
         created_attrs: dict[str, dict[str, bool]] = {}
-        for cls in [n for n in ast.walk(ctx.tree)
-                    if isinstance(n, ast.ClassDef)]:
+        for cls in ctx.nodes(ast.ClassDef):
             attrs: dict[str, bool] = {}
             for n in ast.walk(cls):
                 if isinstance(n, ast.Assign) and \
@@ -407,6 +419,10 @@ class ShmForeignUnlink(Rule):
 class NakedPickleLoads(Rule):
     id = "C005"
     name = "naked-pickle-loads"
+    why = ("pickle.loads on wire bytes is arbitrary code execution in the "
+           "receiving process.")
+    fix = ("Route deserialization through runtime/wire.py's restricted "
+           "unpickler.")
     description = ("pickle.loads / pickle.Unpickler outside the allowlisted "
                    "unpickler module (apex_tpu/runtime/wire.py): a bare "
                    "unpickle of cross-process bytes is arbitrary code "
@@ -437,9 +453,7 @@ class NakedPickleLoads(Rule):
         if ctx.path.replace("\\", "/").endswith(self.ALLOWED_SUFFIX):
             return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             what = self._is_naked_load(node)
             if what is None:
                 continue
@@ -489,6 +503,10 @@ def _is_port_name(name: str) -> bool:
 class PortCollision(Rule):
     id = "J012"
     name = "port-collision"
+    why = ("Two roles bound to one literal port collide at bind time when "
+           "co-hosted.")
+    fix = ("Derive every port from CommsConfig offsets so the topology "
+           "allocates uniquely.")
     description = ("two roles config-bound to the same literal port in one "
                    "topology: a CommsConfig-style construction (or config "
                    "class body) assigning the same constant to two "
@@ -498,11 +516,10 @@ class PortCollision(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Call):
-                out.extend(self._check_call(ctx, node))
-            elif isinstance(node, ast.ClassDef):
-                out.extend(self._check_class(ctx, node))
+        for node in ctx.nodes(ast.Call):
+            out.extend(self._check_call(ctx, node))
+        for node in ctx.nodes(ast.ClassDef):
+            out.extend(self._check_class(ctx, node))
         return out
 
     def _collide(self, ctx: ModuleContext, node: ast.AST,
@@ -562,6 +579,10 @@ class PortCollision(Rule):
 class ZmqThreadAffinity(Rule):
     id = "J013"
     name = "zmq-thread-affinity"
+    why = ("A zmq socket is thread-bound; touching it from two thread entries "
+           "corrupts the channel.")
+    fix = ("Give each thread its own socket, or marshal through the owning "
+           "thread's queue.")
     description = ("a zmq socket attribute of one class is touched from "
                    "two different thread-entry methods (Thread targets): "
                    "zmq sockets are not thread-safe, and concurrent use "
@@ -571,8 +592,7 @@ class ZmqThreadAffinity(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for cls in [n for n in ast.walk(ctx.tree)
-                    if isinstance(n, ast.ClassDef)]:
+        for cls in ctx.nodes(ast.ClassDef):
             out.extend(self._check_class(ctx, cls))
         return out
 
@@ -679,6 +699,10 @@ class ZmqThreadAffinity(Rule):
 class UnregisteredGauge(Rule):
     id = "J015"
     name = "unregistered-gauge"
+    why = ("A gauge key outside the registry silently vanishes from "
+           "exposition and alerting.")
+    fix = ("Declare the key in apex_tpu.obs.metrics (REGISTERED_GAUGES / "
+           "REGISTERED_FAMILIES) first.")
     description = ("a literal heartbeat-gauge key or Prometheus "
                    "exposition family name outside the declared metric "
                    "registry (apex_tpu.obs.metrics REGISTERED_GAUGES / "
@@ -777,21 +801,30 @@ class UnregisteredGauge(Rule):
                 self._check_keys(ctx, d, gauges_reg, "heartbeat gauge",
                                  out)
         seen_fn_targets: set[str] = set()
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        # _dict_assigns walks the whole enclosing function: memoize it
+        # per scope (and skip it entirely for sink-free calls) or the
+        # rule goes quadratic in function size over call-heavy modules
+        local_cache: dict[ast.AST, dict] = {}
+
+        def local_for(node: ast.Call) -> dict:
             fn_scope = ctx.enclosing_function(node)
-            local = (self._dict_assigns(fn_scope)
-                     if fn_scope is not None else {})
+            if fn_scope is None:
+                return {}
+            got = local_cache.get(fn_scope)
+            if got is None:
+                got = local_cache[fn_scope] = self._dict_assigns(fn_scope)
+            return got
+
+        for node in ctx.nodes(ast.Call):
             # 2) Heartbeat(gauges={...}) and gauges_fn=... sinks
             gv = _kwarg(node, "gauges")
             if gv is not None and _callee_basename(node) == "Heartbeat":
-                for d in self._resolve_dicts(gv, local):
+                for d in self._resolve_dicts(gv, local_for(node)):
                     self._check_keys(ctx, d, gauges_reg,
                                      "heartbeat gauge", out)
             gf = _kwarg(node, "gauges_fn")
             if gf is not None:
-                for d in self._resolve_dicts(gf, local):
+                for d in self._resolve_dicts(gf, local_for(node)):
                     self._check_keys(ctx, d, gauges_reg,
                                      "heartbeat gauge", out)
                 # a named/bound hook (`gauges_fn=self.ondevice_counters`)
@@ -812,7 +845,7 @@ class UnregisteredGauge(Rule):
                     v = _kwarg(node, kw)
                     if v is None:
                         continue
-                    for d in self._resolve_dicts(v, local):
+                    for d in self._resolve_dicts(v, local_for(node)):
                         self._check_keys(ctx, d, families_reg,
                                          "exposition family", out)
         # 4) exposition builders: render_*/prometheus_* functions that
@@ -840,6 +873,10 @@ class UnregisteredGauge(Rule):
 class RawEpochComparison(Rule):
     id = "J016"
     name = "raw-epoch-comparison"
+    why = ("Raw ordering comparisons on epoch/version counters re-derive the "
+           "fence protocol ad hoc.")
+    fix = ("Compare through serving/fence.py's helpers, the one audited "
+           "ordering site.")
     description = ("an ordering comparison (<, <=, >, >=) on a "
                    "learner_epoch/param_version attribute outside the "
                    "model-version fencing helpers (apex_tpu/serving/"
@@ -872,9 +909,7 @@ class RawEpochComparison(Rule):
         if path.endswith(self._EXEMPT):
             return []
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Compare):
-                continue
+        for node in ctx.nodes(ast.Compare):
             if not any(isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
                        for op in node.ops):
                 continue            # ==/!= identity checks are fine
@@ -908,6 +943,10 @@ class RawEpochComparison(Rule):
 class CrossTenantId(Rule):
     id = "J017"
     name = "cross-tenant-id"
+    why = ("Hand-joined tenant identifiers drift from the namespace grammar "
+           "and can cross tenants.")
+    fix = ("Build ids with tenancy/namespace.py helpers (qualify/chunk_id), "
+           "never by string concat.")
     description = ("a tenant-qualified identifier built by string "
                    "concatenation/formatting (a tenant value joined to "
                    "identity/chunk-id/topic parts with the namespace "
@@ -1031,14 +1070,13 @@ class CrossTenantId(Rule):
         # one finding per concat CHAIN: sub-chains of an already-checked
         # Add chain are skipped (walk yields both)
         inner_adds: set[int] = set()
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.BinOp) and isinstance(node.op,
-                                                          ast.Add):
+        for node in ctx.nodes(ast.BinOp):
+            if isinstance(node.op, ast.Add):
                 for child in (node.left, node.right):
                     if isinstance(child, ast.BinOp) \
                             and isinstance(child.op, ast.Add):
                         inner_adds.add(id(child))
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.JoinedStr, ast.BinOp, ast.Call):
             hit = False
             if isinstance(node, ast.JoinedStr):
                 hit = self._check_joinedstr(node)
@@ -1063,6 +1101,10 @@ class CrossTenantId(Rule):
 class QuotaAccounting(Rule):
     id = "J018"
     name = "quota-accounting"
+    why = ("Hand-rolled min(ingested, capacity) arithmetic drifts from the "
+           "shard's residency ledger.")
+    fix = ("Call replay_service/shard.py's residency accounting instead of "
+           "recomputing it.")
     description = ("a replay residency count computed by hand — "
                    "min(<ingested>, <capacity>) — or an ordering "
                    "comparison between an ingested count and a quota "
@@ -1104,7 +1146,7 @@ class QuotaAccounting(Rule):
         if path.endswith(self._EXEMPT):
             return []
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.Call, ast.Compare):
             if isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Name) \
                     and node.func.id == "min" and len(node.args) >= 2:
@@ -1141,6 +1183,10 @@ class QuotaAccounting(Rule):
 class CtlThreadAffinity(Rule):
     id = "J019"
     name = "ctl-thread-affinity"
+    why = ("Status-server hooks run on their own thread; mutating trainer "
+           "state there races the step.")
+    fix = ("Hooks read snapshots or enqueue commands for the trainer thread "
+           "to apply.")
     description = ("learner/trainer state mutated from a FleetStatusServer "
                    "hook: the status server runs ctl_fn/metrics_fn/"
                    "snapshot_fn on ITS OWN thread, while train_state/"
@@ -1208,9 +1254,8 @@ class CtlThreadAffinity(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call)
-                    and _callee_basename(node) == "FleetStatusServer"):
+        for node in ctx.nodes(ast.Call):
+            if _callee_basename(node) != "FleetStatusServer":
                 continue
             cls = self._enclosing_class(ctx, node)
             methods = self._class_methods(cls) if cls is not None else {}

@@ -73,14 +73,18 @@ def _loops_between(ctx: ModuleContext, node: ast.AST, stop: ast.AST | None):
 class JitMissingDonation(Rule):
     id = "J001"
     name = "jit-missing-donation"
+    why = ("Un-donated jit step buffers keep the old state alive across the "
+           "update and double learner HBM.")
+    fix = ("Pass donate_argnums for the state buffers the step consumes and "
+           "rebind them from the result.")
     description = ("jit-wrapped train/ingest step without donate_argnums: "
                    "the old state buffers stay live across the update and "
                    "double learner HBM")
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)):
+        for node in ctx.nodes(ast.Call):
+            if not is_jit_expr(node.func):
                 continue
             if not node.args or _has_donation(node):
                 continue
@@ -122,6 +126,10 @@ class JitMissingDonation(Rule):
 class HostSyncInJit(Rule):
     id = "J002"
     name = "host-sync-in-jit"
+    why = ("A host conversion on a traced value inside jit breaks tracing or "
+           "forces a device sync.")
+    fix = ("Keep the math in jnp inside the jitted scope; materialize on the "
+           "host after dispatch.")
     description = ("float()/int()/bool()/.item()/np.asarray() on a traced "
                    "value inside a jitted function: forces a host-device "
                    "sync per call and serializes the pipeline")
@@ -132,9 +140,7 @@ class HostSyncInJit(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             fn = ctx.in_jitted_scope(node)
             if fn is None:
                 continue
@@ -170,6 +176,10 @@ class HostSyncInJit(Rule):
 class TracedPythonBranch(Rule):
     id = "J003"
     name = "traced-python-branch"
+    why = ("Python control flow on a traced value errors at trace time or "
+           "silently retraces per branch.")
+    fix = ("Branch with lax.cond/lax.select (or jnp.where) so the choice "
+           "compiles into the program.")
     description = ("Python if/while on a traced value inside a jitted "
                    "function: either a tracer-bool error at trace time or "
                    "a silent retrace per branch — use lax.cond/lax.select")
@@ -184,9 +194,7 @@ class TracedPythonBranch(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, (ast.If, ast.While)):
-                continue
+        for node in ctx.nodes(ast.If, ast.While):
             fn = ctx.in_jitted_scope(node)
             if fn is None:
                 continue
@@ -269,6 +277,10 @@ def _is_key_source(call: ast.Call) -> bool:
 class PRNGKeyReuse(Rule):
     id = "J004"
     name = "prng-key-reuse"
+    why = ("A PRNG key consumed twice correlates draws that must be "
+           "independent.")
+    fix = ("jax.random.split the key and consume each subkey exactly once "
+           "(split per loop iteration).")
     description = ("a PRNG key consumed more than once (or consumed inside "
                    "a loop without a per-iteration split): correlated "
                    "randomness silently corrupts exploration and "
@@ -498,6 +510,10 @@ def _is_trace_context(expr: ast.AST) -> bool:
 class HostSyncInHotLoop(Rule):
     id = "J006"
     name = "host-sync-in-hot-loop"
+    why = ("A blocking device read in the hot loop serializes dispatch "
+           "against the device each step.")
+    fix = ("Drop the sync from the steady-state path; read results at "
+           "episode/log boundaries.")
     description = ("block_until_ready()/jax.device_get() inside a host-side "
                    "loop outside profiling scopes: a full device drain per "
                    "iteration serializes the async-dispatch pipeline the "
@@ -539,9 +555,7 @@ class HostSyncInHotLoop(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             kind = self._sync_kind(node)
             if kind is None:
                 continue
@@ -568,6 +582,10 @@ class HostSyncInHotLoop(Rule):
 class DevicePutInJit(Rule):
     id = "J007"
     name = "device-put-in-jit"
+    why = ("device_put inside compiled code is at best a redundant copy, at "
+           "worst a per-call transfer.")
+    fix = ("Stage operands onto the device before the dispatch and pass "
+           "device arrays in.")
     description = ("jax.device_put inside jitted/shard_map scope: a "
                    "placement request inside compiled code is at best a "
                    "redundant copy and at worst a per-call transfer — "
@@ -579,9 +597,7 @@ class DevicePutInJit(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             f = node.func
             if not (isinstance(f, ast.Attribute)
                     and f.attr in self._PUT_ATTRS
@@ -612,8 +628,8 @@ def _jit_callable_names(ctx: ModuleContext) -> set[str]:
     Deliberately NOT the transitive jitted-scope closure — calling a
     helper that jitted code also calls is a plain host call."""
     out: set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call) or not is_jit_expr(node.func):
+    for node in ctx.nodes(ast.Call):
+        if not is_jit_expr(node.func):
             continue
         if node.args:
             tgt = node.args[0]
@@ -647,6 +663,10 @@ def _is_timed_context(expr: ast.AST) -> bool:
 class EagerJitMaterialize(Rule):
     id = "J008"
     name = "eager-jit-materialize"
+    why = ("Materializing a jit result inline blocks the dispatch pipeline on "
+           "the transfer.")
+    fix = ("Keep results on device; convert to host types only where they are "
+           "consumed.")
     description = ("np.asarray()/jax.device_get() materializing a jitted "
                    "result in a host step loop with the value consumed "
                    "more than one statement later: the blocking sync "
@@ -778,6 +798,10 @@ _J009_MATERIALIZERS = {"asarray", "array", "device_get", "int", "float",
 class DeviceArrayOnMpQueue(Rule):
     id = "J009"
     name = "device-array-on-mp-queue"
+    why = ("Queue.put pickles a device array, forcing an implicit "
+           "device->host copy and sync.")
+    fix = ("Materialize with np.asarray/jax.device_get first and enqueue the "
+           "host array.")
     description = ("mp.Queue put of a jitted/device result without a host "
                    "materialize: Queue.put pickles the object, forcing an "
                    "implicit device->host copy (and a device sync) per "
@@ -877,6 +901,9 @@ _OBS_RING_METHODS = {"complete", "complete_wall", "instant"}
 class HostClockInJit(Rule):
     id = "J010"
     name = "host-clock-in-jit"
+    why = ("time.time() under jit bakes the trace-time clock into the "
+           "compiled program as a constant.")
+    fix = "Read clocks on the host and pass timestamps in as arguments."
     description = ("time.time()/time.perf_counter()/time.monotonic() (or an "
                    "obs-plane span/ring emission) inside jit/shard_map "
                    "trace scope: the clock reads at TRACE time, so every "
@@ -909,9 +936,7 @@ class HostClockInJit(Rule):
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             fn = ctx.in_jitted_scope(node)
             if fn is None:
                 continue
@@ -943,6 +968,10 @@ _SHARD_MAP_NAMES = {"shard_map", "shard_map_compat", "pjit"}
 class ShardingAnnotationDrift(Rule):
     id = "J011"
     name = "sharding-annotation-drift"
+    why = ("A PartitionSpec axis name no declared mesh axis matches silently "
+           "degrades to replication.")
+    fix = ("Name axes from the declared mesh ('dp'/'tp' in parallel/mesh.py) "
+           "or extend the mesh.")
     description = ("a PartitionSpec axis name in pjit/shard_map "
                    "in/out shardings that no declared mesh axis matches "
                    "(parallel/mesh.py declares ('dp', 'tp')): the spec "
@@ -957,7 +986,7 @@ class ShardingAnnotationDrift(Rule):
         judges drift, not style)."""
         axes: set[str] = set()
         canonical = False
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.ImportFrom, ast.Call):
             if isinstance(node, ast.ImportFrom):
                 if node.module and node.module.endswith("parallel.mesh"):
                     canonical = True
@@ -1012,9 +1041,8 @@ class ShardingAnnotationDrift(Rule):
         if declared is None:
             return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call)
-                    and call_name(node) in _SPEC_CTORS):
+        for node in ctx.nodes(ast.Call):
+            if call_name(node) not in _SPEC_CTORS:
                 continue
             scope = self._annotation_scope(ctx, node)
             if scope is None:
@@ -1038,14 +1066,18 @@ class ShardingAnnotationDrift(Rule):
 class JitInLoop(Rule):
     id = "J005"
     name = "jit-in-loop"
+    why = ("jax.jit inside a loop builds a fresh callable per iteration, "
+           "retracing every time.")
+    fix = ("Hoist the jit to construction time and call the cached callable "
+           "in the loop.")
     description = ("jax.jit(...) invoked inside a loop body: builds a fresh "
                    "wrapper (and usually retraces) every iteration — hoist "
                    "the jitted callable out of the loop")
 
     def check(self, ctx: ModuleContext) -> list[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)):
+        for node in ctx.nodes(ast.Call):
+            if not is_jit_expr(node.func):
                 continue
             if _loops_between(ctx, node, None):
                 out.append(ctx.finding(
@@ -1062,6 +1094,9 @@ class JitInLoop(Rule):
 class HostNumpyOpInScannedEnv(Rule):
     id = "J014"
     name = "host-numpy-op-in-scanned-env"
+    why = ("Host numpy inside a scanned env step runs per step on the host, "
+           "defeating the scan.")
+    fix = "Express the step in jnp so lax.scan keeps the rollout on device."
     description = ("np.* / float() / .item() reachable from a function "
                    "passed to lax.scan (a scanned env/rollout body, "
                    "training/anakin.py discipline): host numpy executes at "
@@ -1078,8 +1113,8 @@ class HostNumpyOpInScannedEnv(Rule):
         body, nested defs, and the transitive same-module call graph
         (the jitted-scope closure's discipline, re-rooted at scan)."""
         seeds: set[str] = set()
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call) and node.args):
+        for node in ctx.nodes(ast.Call):
+            if not node.args:
                 continue
             f = node.func
             if not (isinstance(f, ast.Attribute) and f.attr == "scan"

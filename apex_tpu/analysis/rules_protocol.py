@@ -1,0 +1,324 @@
+"""Protocol rules: the cross-module invariants PRs 9-15 left to convention.
+
+These ride the whole-program layer (``graph.ProjectContext`` +
+``dataflow``): donation lifetimes (J020), shard-band membership (J021),
+epoch/version fencing (J022), and thread affinity taken across module
+boundaries (C006).  Each follows the single-construction-site pattern
+J016/J017/J018 established — ONE module may hold the raw arithmetic,
+everyone else routes through its helpers — and every rule degrades to
+per-file behavior when ``ctx.project`` is None (lone-snippet analysis).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from apex_tpu.analysis import dataflow
+from apex_tpu.analysis.core import (Finding, ModuleContext, Rule,
+                                    register)
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _basename(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _norm_path(ctx: ModuleContext) -> str:
+    return ctx.path.replace(os.sep, "/")
+
+
+def _name_mentions(node: ast.AST, needles: tuple[str, ...]) -> bool:
+    """Any Name/Attribute (or f-string text) under ``node`` whose lowered
+    spelling contains one of ``needles``."""
+    for n in ast.walk(node):
+        name = _basename(n)
+        if name and any(s in name.lower() for s in needles):
+            return True
+    return False
+
+
+def _constant_expr(node: ast.AST) -> bool:
+    """An expression made only of constants/operators (``2 ** 31``) —
+    a literal modulus is a range clamp or seed mask, never a live shard
+    count."""
+    return not any(isinstance(n, (ast.Name, ast.Attribute, ast.Call))
+                   for n in ast.walk(node))
+
+
+# -- J020 -------------------------------------------------------------------
+
+
+@register
+class DonationAliasing(Rule):
+    id = "J020"
+    name = "donation-aliasing"
+    description = (
+        "a reference to a donated buffer read after the dispatch that "
+        "consumed it: jax.jit(fn, donate_argnums=...) invalidates the "
+        "donated argument buffers AT DISPATCH, so any post-call read of "
+        "the pre-dispatch reference — a stale local, an attribute the "
+        "epilogue forgot to rebind, or the same name re-passed on the "
+        "next loop iteration without rebinding — returns a deleted "
+        "buffer.  The FusedStep.dispatch epilogue contract is the fix: "
+        "rebind EVERY donated argument from the dispatch results in the "
+        "same statement, then touch only the results")
+    why = ("donation invalidates the argument buffer at dispatch; a "
+           "post-call read of the old reference is a deleted-buffer bug")
+    fix = ("rebind every donated arg from the dispatch results in the "
+           "same statement (the FusedStep.dispatch epilogue discipline)")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        out: list[Finding] = []
+        for h in dataflow.donation_hazards(ctx):
+            if h.loop_carried:
+                out.append(ctx.finding(
+                    self, h.read,
+                    f"donated argument '{h.arg_path}' is re-passed on the "
+                    f"next loop iteration without being rebound from the "
+                    f"dispatch results — the second dispatch consumes a "
+                    f"buffer the first already donated"))
+            else:
+                out.append(ctx.finding(
+                    self, h.read,
+                    f"'{h.arg_path}' read after the dispatch that donated "
+                    f"it — the buffer was consumed; rebind it from the "
+                    f"dispatch results (the FusedStep epilogue contract) "
+                    f"or read the returned value instead"))
+        return out
+
+
+# -- J021 -------------------------------------------------------------------
+
+
+@register
+class BandMembership(Rule):
+    id = "J021"
+    name = "band-membership"
+    description = (
+        "shard-index arithmetic on a tenant identity outside the tenancy "
+        "helpers (apex_tpu/tenancy/namespace.py): a raw "
+        "crc32(key) % n_shards spelled at a call site hashes over the "
+        "WHOLE tier, so the moment the placement scheduler assigns a "
+        "tenant a weighted shard BAND the caller routes traffic to "
+        "shards outside the band — another tenant's partition.  Route "
+        "every identity->shard mapping through "
+        "namespace.shard_in_band(key, band) (the full tier is "
+        "shard_in_band(key, range(n)))")
+    why = ("a raw hash % n_shards ignores the scheduler's shard bands "
+           "and lands one tenant's traffic in another's partition")
+    fix = ("route identity->shard mapping through tenancy "
+           "namespace.shard_in_band(key, band); full tier = "
+           "shard_in_band(key, range(n))")
+
+    #: THE banding module: the one place the raw modulo may live
+    _EXEMPT = ("apex_tpu/tenancy/namespace.py", "tenancy/namespace.py")
+    #: integer content hashes the planes shard with (salted builtin hash()
+    #: included: sharding with it is its own bug)
+    _HASHES = frozenset({"crc32", "adler32", "hash"})
+    #: shard/band-count spellings for the modulus side
+    _COUNTS = ("shard", "band")
+    #: identity-carrying spellings for the hashed key side
+    _IDS = ("identity", "tenant", "chunk", "worker", "peer", "actor")
+
+    def _hash_call(self, node: ast.AST) -> ast.Call | None:
+        """The crc32-family call under (possibly int()/abs()-wrapped)
+        ``node``."""
+        if isinstance(node, ast.Call):
+            base = _basename(node.func)
+            if base in self._HASHES:
+                return node
+            if base in ("int", "abs") and node.args:
+                return self._hash_call(node.args[0])
+        return None
+
+    def _countish(self, node: ast.AST) -> bool:
+        """Does the modulus look like a shard/band count?  Names and
+        attributes containing shard/band, ``len()`` of such, and
+        ``max()``/``int()`` wrappers thereof."""
+        if isinstance(node, ast.Call):
+            base = _basename(node.func)
+            if base in ("len", "max", "min", "int"):
+                return any(self._countish(a) for a in node.args)
+            return False
+        name = _basename(node)
+        return bool(name and any(s in name.lower() for s in self._COUNTS))
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if _norm_path(ctx).endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        for node in ctx.nodes(ast.BinOp):
+            if not isinstance(node.op, ast.Mod):
+                continue
+            call = self._hash_call(node.left)
+            if call is None:
+                continue
+            if _constant_expr(node.right):
+                continue        # seed mask / range clamp, not a tier size
+            key_like = any(_name_mentions(a, self._IDS)
+                           for a in call.args)
+            if not (self._countish(node.right) or key_like):
+                continue
+            out.append(ctx.finding(
+                self, node,
+                "raw shard-index arithmetic (hash % shard count) outside "
+                "the tenancy helpers — once scheduler bands go live this "
+                "routes outside the tenant's band; use "
+                "tenancy.namespace.shard_in_band(key, band) "
+                "(full tier: shard_in_band(key, range(n)))"))
+        return out
+
+
+# -- J022 -------------------------------------------------------------------
+
+
+@register
+class FenceOrdering(Rule):
+    id = "J022"
+    name = "fence-ordering"
+    description = (
+        "a (learner_epoch, param_version) fence tuple constructed "
+        "outside the fencing helpers (apex_tpu/serving/fence.py): J016 "
+        "already bans raw ORDERING on the components; a hand-built pair "
+        "is the cross-module version of the same fork — it skips "
+        "fence_key's None/absent clamping, and a transposed "
+        "(version, epoch) pair silently inverts the epoch-major order "
+        "everywhere the tuple later flows.  Build fences with "
+        "fence.fence_key(epoch, version) and compare with "
+        "fence.beyond/at_or_before")
+    why = ("a hand-built (epoch, version) tuple skips fence_key's "
+           "clamping and can transpose the epoch-major order")
+    fix = ("construct fences with serving.fence.fence_key(epoch, "
+           "version); compare via fence.beyond/at_or_before")
+
+    #: THE fencing module
+    _EXEMPT = ("apex_tpu/serving/fence.py", "serving/fence.py")
+    _NAMES = frozenset({"learner_epoch", "param_version"})
+
+    def _component(self, node: ast.AST) -> str | None:
+        name = _basename(node)
+        return name if name in self._NAMES else None
+
+    def _is_fence_pair(self, node: ast.AST) -> bool:
+        if not (isinstance(node, ast.Tuple) and len(node.elts) == 2):
+            return False
+        got = {self._component(e) for e in node.elts}
+        return got == self._NAMES
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if _norm_path(ctx).endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        for node in ctx.nodes(ast.Tuple):
+            if not self._is_fence_pair(node):
+                continue
+            parent = ctx.parents.get(node)
+            # the parallel-assignment snapshot idiom reads the
+            # components simultaneously without an ordered pair value
+            # ever escaping: `pv, epoch = x.param_version, x.learner_epoch`
+            if isinstance(parent, ast.Assign) and parent.value is node \
+                    and all(isinstance(t, (ast.Tuple, ast.List))
+                            for t in parent.targets):
+                continue
+            out.append(ctx.finding(
+                self, node,
+                "fence tuple (learner_epoch, param_version) built by "
+                "hand outside serving/fence.py — construct it with "
+                "fence.fence_key(epoch, version) so clamping and the "
+                "epoch-major order have one spelling"))
+        return out
+
+
+# -- C006 -------------------------------------------------------------------
+
+
+@register
+class CrossModuleThreadAffinity(Rule):
+    id = "C006"
+    name = "cross-module-thread-affinity"
+    description = (
+        "trainer/device state mutated from a thread-spawn site in one "
+        "module while a jitted hot path in ANOTHER module reads it "
+        "un-locked: J019 catches the FleetStatusServer hooks per file; "
+        "this is the same contract taken whole-program over the "
+        "ProjectContext call graph — any function reachable from a "
+        "threading.Thread(target=...) spawn that assigns a "
+        "trainer-thread-only attribute (train_state/replay_state/core/"
+        "carry...) races every other module's compiled step that closes "
+        "over it.  Enqueue the mutation and apply it on the owning "
+        "thread (the ctl-queue drain pattern), or hold the state's lock")
+    why = ("a thread-reachable mutation of trainer-thread-only state "
+           "races another module's jitted hot path mid-dispatch")
+    fix = ("enqueue the mutation and drain it on the owning thread "
+           "(ctl-queue pattern), or guard both sides with the state's "
+           "lock")
+
+    #: trainer/device-state spellings a spawned thread may never assign
+    #: (the J019 contract minus the broad per-file names): each is read
+    #: from inside a compiled program somewhere in the tree
+    _STATE = frozenset({"train_state", "replay_state", "core",
+                        "carry", "carry_frames", "ingested_dev"})
+
+    @staticmethod
+    def _under_lock(ctx: ModuleContext, node: ast.AST) -> bool:
+        for a in ctx.ancestors(node):
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    if _name_mentions(item.context_expr, ("lock",)):
+                        return True
+        return False
+
+    def _hot_readers(self, project, attr: str, skip_path: str) -> str | None:
+        """Path of another module whose jitted scope reads ``.attr``."""
+        for path, info in project.modules.items():
+            if path == skip_path:
+                continue
+            mctx = info.ctx
+            for fn in mctx.jitted:
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) and n.attr == attr \
+                            and isinstance(n.ctx, ast.Load):
+                        return path
+        return None
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        project = ctx.project
+        if project is None:
+            return []           # whole-program only: no project, no view
+        info = project.modules.get(_norm_path(ctx))
+        if info is None:
+            return []
+        out: list[Finding] = []
+        for fn in ctx.functions:
+            qual = project.qualname_of(info, fn)
+            if qual not in project.thread_reachable:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and t.attr in self._STATE):
+                        continue
+                    if self._under_lock(ctx, node):
+                        continue
+                    reader = self._hot_readers(project, t.attr, info.path)
+                    if reader is None:
+                        continue
+                    out.append(ctx.finding(
+                        self, node,
+                        f"'.{t.attr}' assigned in {fn.name}() — reachable "
+                        f"from a Thread(target=...) spawn — while a "
+                        f"jitted hot path in {reader} reads it un-locked; "
+                        f"trainer/device state is owning-thread-only: "
+                        f"enqueue the mutation and drain it there, or "
+                        f"lock both sides"))
+        return out

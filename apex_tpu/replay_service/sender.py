@@ -21,16 +21,18 @@ the learner's :class:`~apex_tpu.fleet.registry.FleetRegistry`.
 
 from __future__ import annotations
 
-import zlib
-
 from apex_tpu.config import CommsConfig
 from apex_tpu.runtime import transport
+from apex_tpu.tenancy import namespace as tenancy_ns
 
 
 def chunk_shard(chunk_id: str, n_shards: int) -> int:
     """Stable chunk-id -> shard index (crc32: identical across processes,
-    platforms, and runs — the routing IS the sharding function)."""
-    return zlib.crc32(chunk_id.encode()) % max(1, n_shards)
+    platforms, and runs — the routing IS the sharding function).  Routed
+    through the tenancy band helper (apexlint J021) with the full tier as
+    the band, which is bit-identical to the historical raw
+    ``crc32 % n`` — the tests pin the mapping."""
+    return tenancy_ns.shard_in_band(chunk_id, range(max(1, n_shards)))
 
 
 class ShardedChunkSender:

@@ -22,17 +22,19 @@ fleet uniformly instead of stranding the old mapping's tail.
 
 from __future__ import annotations
 
-import zlib
-
 from apex_tpu.config import CommsConfig
+from apex_tpu.tenancy import namespace as tenancy_ns
 
 
 def infer_shard(identity: str, n_shards: int) -> int:
     """Stable worker-identity -> home-shard index (crc32, like the chunk
     plane's :func:`~apex_tpu.replay_service.sender.chunk_shard`):
-    identical across processes, platforms, and runs."""
+    identical across processes, platforms, and runs.  Routed through the
+    tenancy band helper (apexlint J021) with the full tier as the band —
+    bit-identical to the historical raw ``crc32 % n``, so the pinned
+    mapping tests hold."""
     n = max(1, int(n_shards))
-    return zlib.crc32(f"{identity}#{n}".encode()) % n
+    return tenancy_ns.shard_in_band(f"{identity}#{n}", range(n))
 
 
 def shard_port(comms: CommsConfig, shard: int) -> int:

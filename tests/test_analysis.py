@@ -1728,3 +1728,499 @@ def test_acceptance_command_package_scope():
          "--no-baseline"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+# -- J020: donation aliasing (whole-program dataflow) -----------------------
+
+def test_j020_fires_on_post_dispatch_read():
+    assert fires("""
+        import jax
+
+        class Learner:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def run(self, batch):
+                out = self._step(self.train_state, batch)
+                return float(self.train_state.loss)
+        """, "J020")
+
+
+def test_j020_silent_on_rebind_epilogue():
+    assert not fires("""
+        import jax
+
+        class Learner:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def run(self, batch):
+                self.train_state, metrics = self._step(self.train_state,
+                                                       batch)
+                return metrics
+        """, "J020")
+
+
+def test_j020_fires_on_loop_carried_redispatch():
+    found = run_rule("""
+        import jax
+
+        class Learner:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def run(self, batches):
+                metrics = None
+                for b in batches:
+                    metrics = self._step(self.train_state, b)
+                return metrics
+        """, "J020")
+    assert found and "loop iteration" in found[0].message
+
+
+def test_j020_silent_when_loop_rebinds():
+    assert not fires("""
+        import jax
+
+        class Learner:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+
+            def run(self, batches):
+                for b in batches:
+                    self.train_state, m = self._step(self.train_state, b)
+                return m
+        """, "J020")
+
+
+def test_j020_tracks_decorated_and_factory_donation():
+    # @partial decoration and factory-returned jits both register
+    assert fires("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            return state
+
+        def drive(state, batch):
+            out = step(state, batch)
+            return state.params
+        """, "J020")
+    assert fires("""
+        import jax
+
+        def make(step):
+            return jax.jit(step, donate_argnums=(0,))
+
+        class T:
+            def __init__(self, step):
+                self._train = make(step)
+
+            def run(self, batch):
+                out = self._train(self.train_state, batch)
+                return self.train_state
+        """, "J020")
+
+
+def test_j020_silent_on_undonated_jit():
+    assert not fires("""
+        import jax
+
+        class Learner:
+            def __init__(self, step):
+                self._step = jax.jit(step)
+
+            def run(self, batch):
+                out = self._step(self.train_state, batch)
+                return float(self.train_state.loss)
+        """, "J020")
+
+
+# -- J021: band membership --------------------------------------------------
+
+def test_j021_fires_on_raw_crc32_shard_arith():
+    assert fires("""
+        import zlib
+
+        def route(identity, n_shards):
+            return zlib.crc32(identity.encode()) % n_shards
+        """, "J021")
+
+
+def test_j021_fires_on_wrapped_hash_of_identity():
+    assert fires("""
+        def route(tenant_id, n):
+            return abs(hash(tenant_id)) % n
+        """, "J021")
+
+
+def test_j021_silent_on_constant_modulus_and_round_robin():
+    # seed masks / range clamps use literal moduli; round-robin isn't a hash
+    assert not fires("""
+        import zlib
+
+        def seed_of(name):
+            return zlib.crc32(name.encode()) % 2 ** 31
+        """, "J021")
+    assert not fires("""
+        class S:
+            def pick(self, n_shards):
+                self._seq += 1
+                return self._seq % n_shards
+        """, "J021")
+
+
+def test_j021_exempts_the_tenancy_namespace_module():
+    src = textwrap.dedent("""
+        import zlib
+
+        def shard_in_band(identity, band):
+            return band[zlib.crc32(identity.encode()) % len(band)]
+        """)
+    rules = {"J021": all_rules()["J021"]}
+    findings, _ = analyze_source(src, path="apex_tpu/tenancy/namespace.py",
+                                 rules=rules)
+    assert not findings
+    findings, _ = analyze_source(src, path="elsewhere.py", rules=rules)
+    assert findings
+
+
+# -- J022: fence ordering ---------------------------------------------------
+
+def test_j022_fires_on_handbuilt_fence_tuple():
+    found = run_rule("""
+        class Server:
+            def snapshot(self):
+                return (self.learner_epoch, self.param_version)
+        """, "J022")
+    assert found and "fence" in found[0].message
+    # transposed pairs are the same hazard (that's the point)
+    assert fires("""
+        def key(st):
+            return (st.param_version, st.learner_epoch)
+        """, "J022")
+
+
+def test_j022_silent_on_parallel_assign_snapshot():
+    assert not fires("""
+        class Server:
+            def read(self):
+                pv, epoch = self.param_version, self.learner_epoch
+                return pv
+        """, "J022")
+
+
+def test_j022_silent_on_non_fence_tuples_and_fence_module():
+    assert not fires("""
+        def f(st):
+            return (st.learner_epoch, st.other)
+        """, "J022")
+    src = textwrap.dedent("""
+        def fence_key(st):
+            return (st.learner_epoch, st.param_version)
+        """)
+    findings, _ = analyze_source(src, path="apex_tpu/serving/fence.py",
+                                 rules={"J022": all_rules()["J022"]})
+    assert not findings
+
+
+# -- C006: cross-module thread affinity -------------------------------------
+
+_C006_READER = """
+    import jax
+
+    class Engine:
+        @jax.jit
+        def step(self, x):
+            return x + self.core
+    """
+
+
+def _c006_run(tmp_path, ctl_src):
+    from apex_tpu.analysis import analyze_paths
+    (tmp_path / "ctl.py").write_text(textwrap.dedent(ctl_src))
+    (tmp_path / "engine.py").write_text(textwrap.dedent(_C006_READER))
+    rules = {"C006": all_rules()["C006"]}
+    findings, _ = analyze_paths([str(tmp_path)], rules=rules,
+                                root=str(tmp_path))
+    return findings
+
+
+def test_c006_fires_on_thread_reachable_unlocked_mutation(tmp_path):
+    found = _c006_run(tmp_path, """
+        import threading
+
+        class Ctl:
+            def start(self):
+                self.t = threading.Thread(target=self._loop)
+                self.t.start()
+
+            def _loop(self):
+                self.core = None
+        """)
+    assert [f.rule for f in found] == ["C006"]
+    assert "engine.py" in found[0].message
+
+
+def test_c006_silent_under_lock_and_off_thread(tmp_path):
+    assert not _c006_run(tmp_path, """
+        import threading
+
+        class Ctl:
+            def start(self):
+                self.t = threading.Thread(target=self._loop)
+                self.t.start()
+
+            def _loop(self):
+                with self._state_lock:
+                    self.core = None
+        """)
+    # same mutation NOT reachable from a Thread spawn: trainer-thread code
+    assert not _c006_run(tmp_path, """
+        class Ctl:
+            def reset(self):
+                self.core = None
+        """)
+
+
+def test_c006_needs_the_project_context():
+    # lone-snippet analysis has no cross-module view: the rule stays quiet
+    assert not fires("""
+        import threading
+
+        class Ctl:
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.core = None
+        """, "C006")
+
+
+# -- ProjectContext: graphs and dataflow ------------------------------------
+
+def test_project_context_import_and_call_graphs():
+    from apex_tpu.analysis.graph import ProjectContext
+    proj = ProjectContext({
+        "pkg/__init__.py": "",
+        "pkg/a.py": "from pkg.b import helper\n\n"
+                    "def run():\n    return helper()\n",
+        "pkg/b.py": "def helper():\n    return 1\n",
+    })
+    assert "pkg.b" in proj.import_graph["pkg.a"]
+    assert "pkg.b.helper" in proj.call_graph["pkg.a.run"]
+    assert "pkg.b.helper" in proj.definitions
+
+
+def test_project_context_thread_reachability():
+    from apex_tpu.analysis.graph import ProjectContext
+    proj = ProjectContext({
+        "m.py": textwrap.dedent("""
+            import threading
+
+            def work():
+                helper()
+
+            def helper():
+                pass
+
+            def main():
+                threading.Thread(target=work).start()
+            """),
+    })
+    assert "m.work" in proj.thread_targets
+    # the closure follows call-graph edges out of the spawn target
+    assert {"m.work", "m.helper"} <= proj.thread_reachable
+    assert "m.main" not in proj.thread_reachable
+
+
+def test_reaching_defs_branch_union_and_params():
+    import ast as _a
+
+    from apex_tpu.analysis.dataflow import reaching_defs
+    fn = _a.parse(textwrap.dedent("""
+        def f(x, cond):
+            y = x + 1
+            if cond:
+                y = 2
+            return y
+        """)).body[0]
+    defs = reaching_defs(fn)
+    ret_y = [n for n in defs if n.id == "y"]
+    assert ret_y and len(defs[ret_y[-1]]) == 2      # both branches reach
+    x_loads = [n for n in defs if n.id == "x"]
+    assert x_loads and defs[x_loads[0]] == {fn}     # params reach as fn
+
+
+def test_donated_callables_resolves_bindings_and_factories():
+    from apex_tpu.analysis.core import ModuleContext
+    from apex_tpu.analysis.dataflow import donated_callables
+    ctx = ModuleContext("m.py", textwrap.dedent("""
+        import jax
+
+        def make(step):
+            return jax.jit(step, donate_argnums=(0, 1))
+
+        class T:
+            def __init__(self, step):
+                self._step = jax.jit(step, donate_argnums=(0,))
+                self._train = make(step)
+        """))
+    d = donated_callables(ctx)
+    assert d["self._step"].positions == (0,)
+    assert d["self._train"].positions == (0, 1)
+
+
+# -- SARIF artifact ---------------------------------------------------------
+
+def test_sarif_report_shape(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    sarif = tmp_path / "out.sarif"
+    assert main([bad, "--no-baseline", "--sarif", str(sarif)]) == 1
+    capsys.readouterr()
+    doc = json.loads(sarif.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"J001", "J004", "J020", "J021", "J022", "C006"} <= rule_ids
+    res = [r for r in run["results"] if r["ruleId"] == "J004"]
+    assert res and res[0]["level"] == "error"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] > 0
+
+
+def test_sarif_baselined_findings_are_suppressed_notes(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    base = str(tmp_path / "base.json")
+    assert main([bad, "--baseline", base, "--write-baseline"]) == 0
+    sarif = tmp_path / "out.sarif"
+    assert main([bad, "--baseline", base, "--sarif", str(sarif)]) == 0
+    capsys.readouterr()
+    run = json.loads(sarif.read_text())["runs"][0]
+    res = [r for r in run["results"] if r["ruleId"] == "J004"]
+    assert res and res[0]["level"] == "note"
+    assert res[0]["suppressions"][0]["kind"] == "external"
+
+
+# -- config reader ----------------------------------------------------------
+
+def test_config_multiline_array_with_comments(tmp_path):
+    # regression: a per-item comment used to truncate the folded buffer
+    # at its '#' and silently drop the whole key
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.apexlint]
+        paths = [
+            "apex_tpu",     # the package
+            "tests",        # and its tests
+        ]
+        baseline = ".apexlint-baseline.json"
+        disable = []
+
+        [tool.other]
+        x = "[not # ours]"
+        """))
+    cfg = load_config(str(tmp_path))
+    assert cfg["paths"] == ["apex_tpu", "tests"]
+    assert cfg["baseline"] == ".apexlint-baseline.json"
+    assert cfg["disable"] == []
+
+
+def test_config_bad_values_complain_loudly(tmp_path, capsys):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.apexlint]
+        paths = not-a-value (
+        baseline = ".ok.json"
+        """))
+    cfg = load_config(str(tmp_path))
+    err = capsys.readouterr().err
+    assert "paths" in err and "ignored" in err
+    assert cfg.get("baseline") == ".ok.json"    # later keys still parse
+
+
+def test_config_unterminated_array_complains(tmp_path, capsys):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.apexlint]\ndisable = [\n    \"J001\",\n")
+    cfg = load_config(str(tmp_path))
+    assert "disable" not in cfg
+    assert "unterminated" in capsys.readouterr().err
+
+
+def test_config_hash_inside_quoted_value_survives(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.apexlint]\nbaseline = "base#1.json"  # real comment\n')
+    assert load_config(str(tmp_path))["baseline"] == "base#1.json"
+
+
+# -- catalog / explain ------------------------------------------------------
+
+def test_catalog_covers_every_rule_with_why_and_fix():
+    from apex_tpu.analysis import catalog
+    entries = {e["id"]: e for e in catalog()}
+    assert set(entries) == set(all_rules())
+    for e in entries.values():
+        assert e["why"] and e["fix"], e["id"]
+
+
+def test_explain_prints_why_and_fix(capsys):
+    assert main(["--explain", "J021"]) == 0
+    out = capsys.readouterr().out
+    assert "J021" in out and "why:" in out and "fix:" in out
+    assert main(["--explain", "NOPE"]) == 2
+    capsys.readouterr()
+
+
+def test_readme_rule_table_is_generated(capsys):
+    """The README's rule table is the catalog_markdown() output verbatim
+    (between the apexlint-catalog markers) — regenerate it with
+    `python -m apex_tpu.analysis --catalog-md` after touching rules."""
+    from apex_tpu.analysis import catalog_markdown
+    readme = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
+    start = readme.index("<!-- apexlint-catalog:start -->")
+    end = readme.index("<!-- apexlint-catalog:end -->")
+    block = readme[start:end].split("-->", 1)[1].strip("\n")
+    assert block == catalog_markdown().strip("\n")
+
+
+# -- --changed-only ---------------------------------------------------------
+
+def test_changed_only_lints_just_the_diff_set(tmp_path, capsys):
+    git = lambda *a: subprocess.run(
+        ["git", "-C", str(tmp_path), *a], check=True, capture_output=True,
+        env={**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+    git("init", "-q")
+    (tmp_path / "pyproject.toml").write_text("[tool.apexlint]\n"
+                                             "paths = [\".\"]\n")
+    _write(tmp_path, "committed.py", """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """)
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    _write(tmp_path, "fresh.py", "x = 1\n")
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        # committed.py's J004 is invisible: only fresh.py is linted
+        assert main(["--no-baseline", "--changed-only"]) == 0
+        assert main(["--no-baseline"]) == 1
+    finally:
+        os.chdir(old)
+    capsys.readouterr()
