@@ -46,7 +46,7 @@ REGISTERED_GAUGES = frozenset({
     # the fused-0 heartbeat's counter block, also the fleet_summary
     # "ondevice" section the fused-smoke CI drill asserts on
     "macro_steps", "train_steps", "prio_writebacks", "external_ingest",
-    "steps_per_dispatch", "train_per_step",
+    "steps_per_dispatch", "train_per_step", "dp", "train_ratio",
     # evaluator eval-ladder scores (runtime/roles.py — the SLO engine's
     # model-quality signal and the future canary/promotion gate input)
     "eval_band", "eval_episodes", "eval_score_last", "eval_score_mean",

@@ -39,12 +39,29 @@ Contracts (pinned in tests/test_ondevice_replay.py):
 
 Differences from the host loop, by design: acting params are the LIVE
 ``train_state.params`` (zero staleness — the Anakin end-state), the
-replay ratio is STRUCTURAL (``B * rollout_len`` transitions ingested per
-``train_per_step`` updates; there is no host band controller inside the
-program), warmup gates training via ``lax.cond`` on the device ingest
-counter, and beta anneals on-device in f32 off that same counter (which
-saturates at ``max(warmup, beta_anneal)+1`` — past both thresholds the
-exact count is irrelevant, so i32 never wraps).
+replay ratio is STRUCTURAL by default (``B * rollout_len`` transitions
+ingested per ``train_per_step`` updates) unless ``train_ratio`` is set —
+then a device-side budget (f32 saturating at 2**24, exact-integer range)
+accumulates ``ratio`` per ingested transition, spends ``batch_size`` per
+update, and gates each train slot with ``lax.cond`` so the one host knob
+serves fused and serial modes alike.  Warmup gates training via
+``lax.cond`` on the device ingest counter, and beta anneals on-device in
+f32 off that same counter (which saturates at ``max(warmup,
+beta_anneal)+1`` — past both thresholds the exact count is irrelevant,
+so i32 never wraps).
+
+**dp mesh (PR 17).**  With ``mesh=`` the whole macro-scan runs under
+``shard_map`` over the ``dp`` axis: env lanes partition as contiguous
+blocks (chip ``s`` owns lanes ``[s*B/dp, (s+1)*B/dp)``), each chip
+feeds its OWN replay-pool partition (the replay state arrives stacked
+``[dp, ...]`` from :meth:`ShardedLearner.shard_replay_state`), each
+train slot samples ``batch_size/dp`` per chip and ``pmean``s gradients
+inside ``update_from_batch(axis_name="dp")``, and the warm/anneal
+counter ``psum``s the per-chip ingest so warmup/beta stay GLOBAL
+quantities.  Per-chip PRNG chains are split host-side with the serial
+discipline (one ``split`` per macro / per train slot, then fanned
+``split(key, dp)`` across chips), so the dp=1 chain is the dp=N chain's
+prefix and the scan-composition parity holds at every width.
 """
 
 from __future__ import annotations
@@ -89,7 +106,8 @@ class FusedStep:
 
     def __init__(self, core, replay, engine, *, warmup: int,
                  beta: float, beta_anneal: int,
-                 steps_per_dispatch: int = 4, train_per_step: int = 1):
+                 steps_per_dispatch: int = 4, train_per_step: int = 1,
+                 mesh=None, train_ratio: float | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -101,6 +119,23 @@ class FusedStep:
         self.core = core
         self.replay = replay
         self.engine = engine
+        self.mesh = mesh
+        self.n_dp = 1 if mesh is None else int(mesh.shape["dp"])
+        self._axis = None if self.n_dp == 1 else "dp"
+        self.ratio = None if train_ratio is None else float(train_ratio)
+        if core.batch_size % self.n_dp:
+            raise ValueError(
+                f"learner.batch_size={core.batch_size} must be divisible "
+                f"by the dp axis (dp={self.n_dp}, from learner.mesh_shape "
+                f"/ --mesh-dp) — raise batch_size or shrink the mesh")
+        self._batch_chip = core.batch_size // self.n_dp
+        if engine.B % self.n_dp:
+            raise ValueError(
+                f"fused dp={self.n_dp} shards the env lanes: "
+                f"B={engine.B} envs (actor.n_actors x "
+                f"actor.n_envs_per_actor) % dp={self.n_dp} != 0 — align "
+                f"--n-envs-per-actor with the mesh (--mesh-dp / "
+                f"APEX_MESH_DP) so every chip gets whole lanes")
         self.N = int(steps_per_dispatch)
         self.P = int(train_per_step)
         self.warmup = int(warmup)
@@ -111,7 +146,35 @@ class FusedStep:
         # for arbitrarily long runs
         self._ing_cap = np.int32(max(self.warmup, self.anneal) + 1)
         self.ingested_dev = jnp.int32(0)
-        self._jit = jax.jit(self._dispatch, donate_argnums=(0, 1, 2, 3, 4))
+        # train_ratio budget: f32 stays integer-exact below 2**24, and a
+        # budget that far ahead means training is the bottleneck anyway
+        self._bud_cap = np.float32(2 ** 24)
+        self.budget_dev = jnp.float32(0.0)
+        if mesh is not None:
+            import copy
+
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            # per-chip engine: the device program depends on B alone
+            # among the per-instance sizes (epsilons/slot_ids are
+            # host-epilogue surfaces), so a shallow copy with B = B/dp
+            # IS the chip's rollout program
+            chip = copy.copy(engine)
+            chip.B = engine.B // self.n_dp
+            self._chip_engine = chip
+            shard = NamedSharding(mesh, P("dp"))
+            self._eps_dev = jax.device_put(
+                np.asarray(jax.device_get(engine.epsilons)), shard)
+            # lay the engine carries out on the mesh once, lane-sharded;
+            # every later dispatch rebinds them from (sharded) results
+            engine.carry = jax.device_put(engine.carry, shard)
+            engine.carry_frames = jax.device_put(engine.carry_frames,
+                                                 shard)
+        else:
+            self._chip_engine = engine
+            self._eps_dev = None
+        self._build_jit()
         # host counters (fleet_summary "ondevice" block; CI asserts)
         self.dispatches = 0
         self.macro_steps = 0
@@ -131,30 +194,58 @@ class FusedStep:
         return (jnp.float32(self.beta0)
                 + jnp.float32(1.0 - self.beta0) * frac)
 
-    def _train_block(self, ts, rs, keys, ing):
+    def _train_block(self, ts, rs, keys, ing, bud):
+        import jax.numpy as jnp
         from jax import lax
         beta = self._beta_at(ing)
 
-        def body(carry, k):
-            ts2, rs2 = carry
+        def train1(ts2, rs2, k):
             batch, weights, idx = self.replay.sample(
-                rs2, k, self.core.batch_size, beta)
+                rs2, k, self._batch_chip, beta, axis_name=self._axis)
             ts2, prios, metrics = self.core.update_from_batch(
-                ts2, batch, weights)
+                ts2, batch, weights, axis_name=self._axis)
             rs2 = self.replay.update_priorities(rs2, idx, prios)
-            return (ts2, rs2), metrics
+            return ts2, rs2, metrics
 
-        (ts, rs), metrics = lax.scan(body, (ts, rs), keys)
-        return ts, rs, metrics
+        if self.ratio is None:
+            def body(carry, k):
+                ts2, rs2 = carry
+                ts2, rs2, metrics = train1(ts2, rs2, k)
+                return (ts2, rs2), metrics
 
-    def _macro(self, carry, xs):
+            (ts, rs), metrics = lax.scan(body, (ts, rs), keys)
+            smask = jnp.ones((self.P,), bool)
+            return ts, rs, bud, metrics, smask
+
+        def body(carry, k):
+            ts2, rs2, bud2 = carry
+            go = bud2 > jnp.float32(0.0)
+
+            def step(args):
+                ts3, rs3 = args
+                return train1(ts3, rs3, k)
+
+            def hold(args):
+                ts3, rs3 = args
+                zero = jnp.float32(0.0)
+                return ts3, rs3, {m: zero for m in _METRIC_KEYS}
+
+            ts2, rs2, metrics = lax.cond(go, step, hold, (ts2, rs2))
+            bud2 = bud2 - jnp.where(go, jnp.float32(self.core.batch_size),
+                                    jnp.float32(0.0))
+            return (ts2, rs2, bud2), (metrics, go)
+
+        (ts, rs, bud), (metrics, smask) = lax.scan(body, (ts, rs, bud),
+                                                   keys)
+        return ts, rs, bud, metrics, smask
+
+    def _macro(self, eng, eps, carry, xs):
         import jax.numpy as jnp
         from jax import lax
 
-        ts, rs, c, cf, ing = carry
+        ts, rs, c, cf, ing, bud = carry
         rkey, skeys = xs
-        eng = self.engine
-        c, cf, out = eng._dispatch(ts.params, eng.epsilons, c, cf, rkey)
+        c, cf, out = eng._dispatch(ts.params, eps, c, cf, rkey)
         B, M = eng.B, eng.M
         prios = acting_priorities(out)                       # [B, M, K]
         sealed = out["sealed"]                               # [B]
@@ -168,44 +259,116 @@ class FusedStep:
                   "obs_ref", "next_ref", "nf", "nt")}
 
         def ingest(carry2, xs2):
-            rs2, ing2 = carry2
+            rs2, d2 = carry2
             sl, pr, do = xs2
             chunk = dict(frames=sl["frames"], n_frames=sl["nf"],
                          n_trans=sl["nt"], action=sl["action"],
                          reward=sl["reward"], discount=sl["discount"],
                          obs_ref=sl["obs_ref"], next_ref=sl["next_ref"])
             rs2 = self.replay.add(rs2, chunk, pr, valid=do)
-            ing2 = jnp.minimum(ing2 + jnp.where(do, sl["nt"], 0),
-                               self._ing_cap)
-            return (rs2, ing2), ()
+            d2 = d2 + jnp.where(do, sl["nt"], 0)
+            return (rs2, d2), ()
 
-        (rs, ing), _ = lax.scan(ingest, (rs, ing),
-                                (slots, flat(prios), mask.reshape(-1)))
+        (rs, delta), _ = lax.scan(ingest, (rs, jnp.int32(0)),
+                                  (slots, flat(prios), mask.reshape(-1)))
+
+        sealed_n = sealed.sum()
+        sealed_mx = sealed.max()
+        n_trans = jnp.where(mask, out["nt"], 0).sum()
+        if self._axis is not None:
+            # warmup/anneal/ratio are GLOBAL quantities: count every
+            # chip's ingest (the collectives also make these ys leaves
+            # honestly replicated for the out_specs=P() assembly)
+            delta = lax.psum(delta, self._axis)
+            sealed_n = lax.psum(sealed_n, self._axis)
+            n_trans = lax.psum(n_trans, self._axis)
+            sealed_mx = lax.pmax(sealed_mx, self._axis)
+        # end-of-macro min == the per-chunk saturating add (i32, d >= 0)
+        ing = jnp.minimum(ing + delta, self._ing_cap)
+        if self.ratio is not None:
+            bud = jnp.minimum(
+                bud + delta.astype(jnp.float32) * jnp.float32(self.ratio),
+                self._bud_cap)
 
         warm = ing >= jnp.int32(self.warmup)
 
         def do_train(args):
-            ts2, rs2 = args
-            return self._train_block(ts2, rs2, skeys, ing)
+            ts2, rs2, bud2 = args
+            return self._train_block(ts2, rs2, skeys, ing, bud2)
 
         def skip(args):
-            ts2, rs2 = args
+            ts2, rs2, bud2 = args
             zero = jnp.zeros((self.P,), jnp.float32)
-            return ts2, rs2, {k: zero for k in _METRIC_KEYS}
+            return (ts2, rs2, bud2, {k: zero for k in _METRIC_KEYS},
+                    jnp.zeros((self.P,), bool))
 
-        ts, rs, metrics = lax.cond(warm, do_train, skip, (ts, rs))
+        ts, rs, bud, metrics, smask = lax.cond(warm, do_train, skip,
+                                               (ts, rs, bud))
         done, ep_ret, ep_len = out["stepped"]
-        ys = dict(metrics=metrics, trained=warm,
-                  sealed=sealed.sum(), sealed_max=sealed.max(),
-                  n_trans=jnp.where(mask, out["nt"], 0).sum(),
+        ys = dict(metrics=metrics, trained=warm, step_mask=smask,
+                  sealed=sealed_n, sealed_max=sealed_mx,
+                  n_trans=n_trans,
                   done=done, ep_ret=ep_ret, ep_len=ep_len)
-        return (ts, rs, c, cf, ing), ys
+        return (ts, rs, c, cf, ing, bud), ys
 
-    def _dispatch(self, ts, rs, c, cf, ing, rkeys, skeys):
+    def _scan_dispatch(self, eng, eps, ts, rs, c, cf, ing, bud,
+                       rkeys, skeys):
+        import functools
+
         from jax import lax
-        (ts, rs, c, cf, ing), ys = lax.scan(
-            self._macro, (ts, rs, c, cf, ing), (rkeys, skeys))
-        return ts, rs, c, cf, ing, ys
+        (ts, rs, c, cf, ing, bud), ys = lax.scan(
+            functools.partial(self._macro, eng, eps),
+            (ts, rs, c, cf, ing, bud), (rkeys, skeys))
+        return ts, rs, c, cf, ing, bud, ys
+
+    def _build_jit(self):
+        """(Re)build the jitted dispatch — plain jit at dp=1, a
+        ``shard_map`` over the dp mesh otherwise.  The donation set is
+        the device-resident carry (ts, rs, carries, ingest counter); the
+        budget scalar and the lane-sharded epsilons are NOT donated (the
+        epsilons buffer is reused every dispatch)."""
+        import jax
+
+        if self.mesh is None:
+            def run(ts, rs, c, cf, ing, bud, rkeys, skeys):
+                return self._scan_dispatch(
+                    self.engine, self.engine.epsilons,
+                    ts, rs, c, cf, ing, bud, rkeys, skeys)
+
+            self._jit = jax.jit(run, donate_argnums=(0, 1, 2, 3, 4))
+            return
+
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.mesh import shard_map_compat
+
+        chip = self._chip_engine
+
+        def per_chip(ts, rs, c, cf, ing, bud, eps, rkeys, skeys):
+            # replay state arrives stacked [dp, ...] sharded on axis 0:
+            # strip this chip's partition, restore the axis on the way
+            # out (the ShardedLearner per-chip idiom); the engine
+            # carries shard on their native lane axis, no strip needed
+            rs = jax.tree.map(lambda x: x[0], rs)
+            rk = jax.random.wrap_key_data(rkeys[:, 0])
+            sk = jax.random.wrap_key_data(skeys[:, :, 0])
+            ts, rs, c, cf, ing, bud, ys = self._scan_dispatch(
+                chip, eps, ts, rs, c, cf, ing, bud, rk, sk)
+            rs = jax.tree.map(lambda x: x[None], rs)
+            return ts, rs, c, cf, ing, bud, ys
+
+        repl, shard = P(), P("dp")
+        lanes = P(None, None, "dp")       # [N, T, B] episode-lane leaves
+        ys_spec = dict(metrics=repl, trained=repl, step_mask=repl,
+                       sealed=repl, sealed_max=repl, n_trans=repl,
+                       done=lanes, ep_ret=lanes, ep_len=lanes)
+        mapped = shard_map_compat(
+            per_chip, mesh=self.mesh,
+            in_specs=(repl, shard, shard, shard, repl, repl, shard,
+                      P(None, "dp"), P(None, None, "dp")),
+            out_specs=(repl, shard, shard, shard, repl, repl, ys_spec),
+            check_vma=False)
+        self._jit = jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
 
     # -- host surface ------------------------------------------------------
 
@@ -220,19 +383,31 @@ class FusedStep:
         from apex_tpu.actors.pool import EpisodeStat
 
         eng = self.engine
+        fan = self.n_dp
         rkeys, skeys = [], []
         for _ in range(self.N):
+            # ONE split per macro step off the engine chain — the serial
+            # discipline at every dp width; dp>1 fans the macro key into
+            # per-chip keys shipped as raw key data ([N, dp, 2] u32,
+            # lane-sharded), re-wrapped per chip inside the shard_map
             eng.key, rk = jax.random.split(eng.key)
-            rkeys.append(rk)
+            rkeys.append(np.asarray(jax.random.key_data(
+                jax.random.split(rk, fan))) if fan > 1 else rk)
             row = []
             for _ in range(self.P):
                 sample_key, k = jax.random.split(sample_key)
-                row.append(k)
-            skeys.append(jnp.stack(row))
+                row.append(np.asarray(jax.random.key_data(
+                    jax.random.split(k, fan))) if fan > 1 else k)
+            skeys.append(np.stack(row) if fan > 1 else jnp.stack(row))
+        rk_arr = np.stack(rkeys) if fan > 1 else jnp.stack(rkeys)
+        sk_arr = np.stack(skeys) if fan > 1 else jnp.stack(skeys)
+        args = [train_state, replay_state, eng.carry, eng.carry_frames,
+                self.ingested_dev, self.budget_dev]
+        if fan > 1:
+            args.append(self._eps_dev)
         (train_state, replay_state, eng.carry, eng.carry_frames,
-         self.ingested_dev, ys) = self._jit(
-            train_state, replay_state, eng.carry, eng.carry_frames,
-            self.ingested_dev, jnp.stack(rkeys), jnp.stack(skeys))
+         self.ingested_dev, self.budget_dev, ys) = self._jit(
+            *args, rk_arr, sk_arr)
         got = jax.device_get(ys)
         if int(got["sealed_max"].max(initial=0)) > eng.M - 1:
             raise RuntimeError(
@@ -244,11 +419,13 @@ class FusedStep:
                              int(ep_len[m, t, b]))
                  for m in range(self.N) for t in range(eng.T)
                  for b in range(eng.B) if done[m, t, b]]
-        trained_mask = np.asarray(got["trained"], bool)
-        trained = int(trained_mask.sum()) * self.P
+        # [N, P] per-slot mask: all warm slots without train_ratio, the
+        # budget-gated subset with it — identical aggregation either way
+        smask = np.asarray(got["step_mask"], bool)
+        trained = int(smask.sum())
         metrics = None
         if trained:
-            metrics = {k: float(np.asarray(v)[trained_mask].mean())
+            metrics = {k: float(np.asarray(v)[smask].mean())
                        for k, v in got["metrics"].items()}
         transitions = int(got["n_trans"].sum())
         self.dispatches += 1
@@ -265,24 +442,34 @@ class FusedStep:
 
     def note_external_ingest(self, n: int) -> None:
         """Host-path chunks (hybrid socket actors) ingested outside the
-        fused program still advance the device warm/anneal counter."""
+        fused program still advance the device warm/anneal counter (and
+        the train-ratio budget, when one is live)."""
         import jax.numpy as jnp
         self.ingested_dev = jnp.minimum(
             self.ingested_dev + jnp.int32(n), self._ing_cap)
+        if self.ratio is not None:
+            self.budget_dev = jnp.minimum(
+                self.budget_dev + jnp.float32(float(n) * self.ratio),
+                self._bud_cap)
         self.external_ingest += int(n)
 
-    def sync_ingested(self, n: int) -> None:
-        """Re-seed the device counter after a checkpoint restore."""
+    def sync_ingested(self, n: int, steps: int = 0) -> None:
+        """Re-seed the device counters after a checkpoint restore —
+        ``n`` transitions ingested, ``steps`` learner updates taken."""
         import jax.numpy as jnp
         self.ingested_dev = jnp.minimum(jnp.int32(min(n, 2 ** 31 - 1)),
                                         self._ing_cap)
+        if self.ratio is not None:
+            self.budget_dev = jnp.minimum(
+                jnp.float32(float(n) * self.ratio
+                            - float(steps) * self.core.batch_size),
+                self._bud_cap)
 
     def rebind(self, core) -> None:
         """Re-jit against a rebuilt core (live lr application — one
         recompile per explore, the apply_hparams contract)."""
-        import jax
         self.core = core
-        self._jit = jax.jit(self._dispatch, donate_argnums=(0, 1, 2, 3, 4))
+        self._build_jit()
 
     def counters(self) -> dict:
         """``fleet_summary.json``'s ``ondevice`` block (the fused-smoke
@@ -296,6 +483,8 @@ class FusedStep:
                 "external_ingest": self.external_ingest,
                 "steps_per_dispatch": self.N,
                 "train_per_step": self.P,
+                "dp": self.n_dp,
+                "train_ratio": float(self.ratio or 0.0),
                 "rollout_len": self.engine.T, "n_envs": self.engine.B}
 
 
@@ -333,9 +522,13 @@ class FusedApexTrainer(ApexTrainer):
     param channel; any host-actor chunks that arrive are absorbed into
     the same replay state between dispatches (hybrid mode).
 
-    Graceful refusals name their knobs: non-jittable envs fail in
-    ``make_jax_env``'s ValueError, a dp>1 mesh fails here before any
-    pool spawns, and non-DQN families fail in the CLI/role wiring.
+    A dp>1 learner mesh shards the WHOLE fused program (env lanes,
+    replay partitions, pmean'd updates — see :class:`FusedStep`); the
+    honest capability limits left are divisibility (lanes and batch must
+    split evenly over the mesh) and their ValueErrors name both knobs.
+    Graceful refusals otherwise: non-jittable envs fail in
+    ``make_jax_env``'s ValueError and non-DQN families fail in the
+    CLI/role wiring.
     """
 
     def __init__(self, config: ApexConfig | None = None,
@@ -347,12 +540,6 @@ class FusedApexTrainer(ApexTrainer):
                  rollout_len: int | None = None,
                  steps_per_dispatch: int = 4, train_per_step: int = 1):
         cfg = config or ApexConfig()
-        if int(np.prod(cfg.learner.mesh_shape)) > 1:
-            raise ValueError(
-                f"--rollout fused requires a single-chip learner mesh "
-                f"(mesh_shape={cfg.learner.mesh_shape}) — set --mesh-dp 1 "
-                f"(APEX_MESH_DP=1); dp>1 learners stay on --rollout "
-                f"ondevice/host (ROADMAP: fused x dp mesh)")
         # non-jittable env ids refuse HERE, before any pool/worker spawns
         from apex_tpu.envs.registry import make_jax_env
         make_jax_env(cfg.env.env_id, cfg.env)
@@ -365,12 +552,17 @@ class FusedApexTrainer(ApexTrainer):
                          respawn_workers=respawn_workers)
         from apex_tpu.training.anakin import make_anakin_engine
         engine = make_anakin_engine(cfg, rollout_len=rollout_len)
+        # dp>1: ApexTrainer._init_sharded already built the mesh, the
+        # stacked per-chip replay partitions, and the replicated train
+        # state — the fused program rides the same layout
+        mesh = self.sharded.mesh if getattr(self, "n_dp", 1) > 1 else None
         self.fused = FusedStep(
             self.core, self.replay, engine,
             warmup=cfg.replay.warmup, beta=cfg.replay.beta,
             beta_anneal=cfg.replay.beta_anneal,
             steps_per_dispatch=steps_per_dispatch,
-            train_per_step=train_per_step)
+            train_per_step=train_per_step,
+            mesh=mesh, train_ratio=train_ratio)
 
     # -- the fused hot loop ------------------------------------------------
 
@@ -510,14 +702,22 @@ class FusedApexTrainer(ApexTrainer):
     def fleet_summary(self):
         snap = super().fleet_summary()
         if snap is not None and getattr(self, "fused", None) is not None:
-            # the fused-smoke CI drill asserts these from the persisted
-            # summary (dispatches/chunks/transitions + >=1 write-back)
-            snap["metrics"]["ondevice"] = self.fused.counters()
+            import jax
+
+            # the fused-smoke CI drills assert these from the persisted
+            # summary (dispatches/chunks/transitions + >=1 write-back;
+            # the dp drill additionally checks one live pool per shard)
+            ond = self.fused.counters()
+            ond["pool_size_per_shard"] = [
+                int(v) for v in np.asarray(
+                    jax.device_get(self.replay_state.size)).reshape(-1)]
+            snap["metrics"]["ondevice"] = ond
         return snap
 
     def _apply_counters(self, meta: dict) -> None:
         super()._apply_counters(meta)
-        self.fused.sync_ingested(self.ingested)
+        self.fused.sync_ingested(self.ingested,
+                                 steps=self.steps_rate.total)
 
     def apply_hparams(self, h: dict) -> dict:
         applied = super().apply_hparams(h)

@@ -84,8 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "prioritized sample + train + priority "
                         "write-back run as ONE jitted program per "
                         "dispatch — the host wakes once per "
-                        "--steps-per-dispatch macro steps (dqn family, "
-                        "dp=1, in-learner replay only).  'host' "
+                        "--steps-per-dispatch macro steps, sharded "
+                        "over --mesh-dp chips (dqn family, in-learner "
+                        "replay only).  'host' "
                         "(default) keeps the generic actor-process "
                         "pipeline")
     p.add_argument("--rollout-len", type=int,
@@ -649,9 +650,11 @@ def _dispatch(args: argparse.Namespace, cfg: ApexConfig,
             if args.rollout == "fused":
                 # the whole rollout -> ingest -> sample -> train ->
                 # write-back cycle as one device program per dispatch
-                # (apex_tpu/ondevice); make_jax_env's ValueError names
-                # non-jittable env ids, the mesh guard names --mesh-dp,
-                # and the family gate fails loud before construction
+                # (apex_tpu/ondevice), sharded over the --mesh-dp axis;
+                # make_jax_env's ValueError names non-jittable env ids,
+                # the divisibility guards name --n-envs-per-actor /
+                # --batch-size vs --mesh-dp, and the family gate fails
+                # loud before construction
                 if args.family != "dqn":
                     raise NotImplementedError(
                         f"--rollout fused currently serves the dqn "

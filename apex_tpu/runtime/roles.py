@@ -185,7 +185,8 @@ def run_learner(cfg: ApexConfig, n_peers: int, total_steps: int,
         from apex_tpu.ondevice.fused import FusedApexTrainer
         try:
             # make_jax_env's ValueError names non-jittable env ids and
-            # the mesh guard names --mesh-dp, both before train()
+            # the dp divisibility guards name --n-envs-per-actor /
+            # --batch-size vs --mesh-dp, all before train()
             trainer = FusedApexTrainer(
                 cfg, logdir=logdir, verbose=verbose,
                 checkpoint_dir=checkpoint_dir, train_ratio=train_ratio,

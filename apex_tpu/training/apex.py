@@ -242,11 +242,21 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 f"mesh_shape={self.cfg.learner.mesh_shape} needs {n} "
                 f"devices, have {len(devices)}")
         mesh = make_mesh(dp=n, devices=devices[:n])
-        sl = self.sharded = ShardedLearner(self.core, mesh)
+        sl = ShardedLearner(self.core, mesh)
         self.replay_state = sl.shard_replay_state(self.replay_state)
         self.train_state = sl.replicate_train_state(self.train_state)
         self.pool = ChunkAggregator(self.pool, n)
+        self._make_sharded_fns(mesh)
 
+    def _make_sharded_fns(self, mesh=None) -> None:
+        """(Re)build the sharded plan's jitted dispatches off the CURRENT
+        core — construction calls this with the fresh mesh; a live lr
+        application (``apply_hparams``) calls it bare to re-jit against
+        the rebuilt optimizer on the mesh already in hand."""
+        from apex_tpu.parallel.learner import ShardedLearner
+
+        sl = self.sharded = ShardedLearner(
+            self.core, mesh if mesh is not None else self.sharded.mesh)
         fused = sl.make_fused_step()
         train = sl.make_train_step()
         ingest = sl.make_ingest()
@@ -289,14 +299,10 @@ class ConcurrentTrainer(CheckpointableTrainer):
         gap = self._dispatch_gap = DispatchGapTimer(ring=ring,
                                                     track="learner-hot-loop")
         client = self.replay_client
-        if client is not None:
-            if getattr(self, "n_dp", 1) > 1:
-                raise ValueError(
-                    "replay service mode requires a dp=1 learner mesh — "
-                    "the shard fleet owns the replay; the dp>1 plan "
-                    "shards it in-learner (ROADMAP: service x dp mesh)")
-            if self._train_batch is None:
-                self._train_batch = self._make_batch_train()
+        if client is not None and self._train_batch is None:
+            # dp>1 included: _make_batch_train shards the service batch
+            # over the mesh and pmeans the update (PR 17)
+            self._train_batch = self._make_batch_train()
         pipeline = None
         if self._use_pipeline():
             from apex_tpu.training.ingest_pipeline import IngestPipeline
@@ -1067,8 +1073,7 @@ class ConcurrentTrainer(CheckpointableTrainer):
         import dataclasses as _dc
         applied: dict = {}
         lr = h.get("lr")
-        if lr is not None and getattr(self, "n_dp", 1) == 1 \
-                and isinstance(self.core, LearnerCore):
+        if lr is not None and isinstance(self.core, LearnerCore):
             lc = self.cfg.learner
             optimizer = make_optimizer(
                 lr=float(lr), decay=lc.rmsprop_decay, eps=lc.rmsprop_eps,
@@ -1077,11 +1082,17 @@ class ConcurrentTrainer(CheckpointableTrainer):
                 lr_decay_steps=lc.lr_decay_steps,
                 lr_decay_rate=lc.lr_decay_rate)
             self.core = _dc.replace(self.core, optimizer=optimizer)
-            self._fused = self.core.jit_fused_step()
-            self._train = self.core.jit_train_step()
-            self._ingest = self.core.jit_ingest()
-            if self._multi is not None:
-                self._multi = self.core.jit_fused_multi_step()
+            if getattr(self, "n_dp", 1) > 1:
+                # the sharded plan closed over the old core — rebuild it
+                # on the mesh already in hand (one recompile per explore,
+                # same contract as the single-shard re-jits below)
+                self._make_sharded_fns()
+            else:
+                self._fused = self.core.jit_fused_step()
+                self._train = self.core.jit_train_step()
+                self._ingest = self.core.jit_ingest()
+                if self._multi is not None:
+                    self._multi = self.core.jit_fused_multi_step()
             self._ingest_multi = None       # re-jit lazily off the new core
             if self._train_batch is not None:
                 self._train_batch = self._make_batch_train()
@@ -1179,16 +1190,55 @@ class ConcurrentTrainer(CheckpointableTrainer):
         body over a shard-sampled batch (the sample half already ran on
         the shard).  Families whose update consumes a PRNG key (AQL
         NoisyNet) receive the shard-split update key with the batch, so
-        the one chain never forks."""
+        the one chain never forks.
+
+        dp>1 (PR 17): the service batch splits over the mesh as
+        contiguous per-chip blocks, the update ``pmean``s over ``dp``,
+        and the per-chip priorities reassemble ``[batch]`` in sample
+        order — the shard write-back path is unchanged."""
         import jax as _jax
         core = self.core
-        if getattr(core, "update_needs_key", False):
-            def train_on_batch(ts, batch, weights, key):
-                return core.update_from_batch(ts, batch, weights, key)
+        needs_key = getattr(core, "update_needs_key", False)
+        sl = getattr(self, "sharded", None)
+        if sl is None or getattr(self, "n_dp", 1) == 1:
+            if needs_key:
+                def train_on_batch(ts, batch, weights, key):
+                    return core.update_from_batch(ts, batch, weights, key)
+            else:
+                def train_on_batch(ts, batch, weights):
+                    return core.update_from_batch(ts, batch, weights)
+            return _jax.jit(train_on_batch, donate_argnums=(0,))
+
+        from jax.sharding import PartitionSpec as _P
+
+        from apex_tpu.parallel.mesh import shard_map_compat
+        sl._per_chip_batch()    # loud divisibility check, names the knobs
+
+        if needs_key:
+            def per_chip(ts, batch, weights, kd):
+                # one replicated update key, folded per chip so the
+                # NoisyNet draws decorrelate (ShardedLearner semantics)
+                key = _jax.random.fold_in(
+                    _jax.random.wrap_key_data(kd),
+                    _jax.lax.axis_index("dp"))
+                return core.update_from_batch(ts, batch, weights, key,
+                                              axis_name="dp")
+            in_specs = (_P(), _P("dp"), _P("dp"), _P())
         else:
-            def train_on_batch(ts, batch, weights):
-                return core.update_from_batch(ts, batch, weights)
-        return _jax.jit(train_on_batch, donate_argnums=(0,))
+            def per_chip(ts, batch, weights):
+                return core.update_from_batch(ts, batch, weights,
+                                              axis_name="dp")
+            in_specs = (_P(), _P("dp"), _P("dp"))
+        mapped = shard_map_compat(
+            per_chip, mesh=sl.mesh, in_specs=in_specs,
+            out_specs=(_P(), _P("dp"), _P()), check_vma=False)
+        jitted = _jax.jit(mapped, donate_argnums=(0,))
+        if needs_key:
+            def train_on_batch(ts, batch, weights, key):
+                return jitted(ts, batch, weights,
+                              _jax.random.key_data(key))
+            return train_on_batch
+        return jitted
 
     def _host_batch_slot(self, item: dict):
         """Serial-path twin of the pipeline's ``_build_batch_slot``:

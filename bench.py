@@ -1180,6 +1180,101 @@ def bench_ondevice_rollout() -> dict:
             "pixel": ab(pixel, 16)}
 
 
+# -- part 1f lane 4: fused macro-step x dp mesh (PR 17) ----------------------
+
+FUSED_DP_DEVICES = int(os.environ.get("BENCH_FUSED_DP_DEVICES", 2))
+FUSED_DP_TIMEOUT = float(os.environ.get("BENCH_FUSED_DP_TIMEOUT", 420.0))
+
+
+def _fused_dp_child(dp: int) -> None:
+    """Child body for one ``fused_dp`` width: a FusedApexTrainer at the
+    given dp on the toy env, timed over warm dispatches.  One JSON line
+    on stdout; the parent holds the hard timeout."""
+    import jax
+
+    from apex_tpu.config import (ActorConfig, ApexConfig, EnvConfig,
+                                 LearnerConfig, ReplayConfig)
+    from apex_tpu.ondevice.fused import FusedApexTrainer
+
+    dispatches = int(os.environ.get("BENCH_FUSED_DP_STEPS", 8))
+    spd = 2
+    cfg = ApexConfig(
+        env=EnvConfig(env_id="ApexCatchSmall-v0", frame_stack=2,
+                      clip_rewards=False, episodic_life=False),
+        replay=ReplayConfig(capacity=4096, warmup=256,
+                            beta_anneal=50_000),
+        learner=LearnerConfig(batch_size=64, compute_dtype="float32",
+                              target_update_interval=500,
+                              publish_interval=50, mesh_shape=(dp,)),
+        actor=ActorConfig(n_actors=1, n_envs_per_actor=32,
+                          send_interval=64))
+    t = FusedApexTrainer(cfg, rollout_len=64, steps_per_dispatch=spd)
+    t.train_state, t.replay_state, t.key, _ = t.fused.dispatch(
+        t.train_state, t.replay_state, t.key)        # compile + warm
+    base_steps = t.fused.train_steps
+    base_trans = t.fused.transitions
+    eng = t.fused.engine                             # full-width B
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        t.train_state, t.replay_state, t.key, _ = t.fused.dispatch(
+            t.train_state, t.replay_state, t.key)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "dp": dp, "devices": jax.device_count(),
+        "n_envs": eng.B, "rollout_len": eng.T,
+        "steps_per_dispatch": spd, "dispatches": dispatches,
+        "frames_per_sec":
+            round(dispatches * spd * eng.T * eng.B / dt, 1),
+        "train_steps_per_sec":
+            round((t.fused.train_steps - base_steps) / dt, 2),
+        "transitions_per_sec":
+            round((t.fused.transitions - base_trans) / dt, 1),
+        "seconds": round(dt, 2)}), flush=True)
+
+
+def bench_fused_dp() -> dict:
+    """Part 1f lane 4 ``fused_dp``: the whole fused training cycle
+    (rollout + ingest + prioritized sample + train + write-back) at dp=1
+    vs dp=N, each width in its own subprocess on a CPU mesh emulated via
+    ``--xla_force_host_platform_device_count`` (so the forced device
+    count never leaks into this process's backend).  Leaf names end in
+    ``per_sec`` so the ``obs.slo --check`` differ classifies both widths
+    higher-better automatically; on a 1-core box ``dp_speedup`` ~1.0 is
+    the honest reading and ``effective_cores`` contextualizes it — the
+    lane exists so a multi-core / TPU artifact shows the scaling."""
+    n_dp = max(2, FUSED_DP_DEVICES)
+    out: dict = {"n_dp": n_dp, "effective_cores": _effective_cores()}
+    for label, dp in (("dp1", 1), ("dpN", n_dp)):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count"
+                            f"={n_dp}")
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fused-dp-child", str(dp)],
+                capture_output=True, text=True,
+                timeout=FUSED_DP_TIMEOUT, env=env)
+        except subprocess.TimeoutExpired:
+            out[label] = {"error":
+                          f"fused_dp child exceeded {FUSED_DP_TIMEOUT}s"}
+            continue
+        lane = None
+        for line in reversed(p.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                lane = json.loads(line)
+                break
+        out[label] = lane if lane is not None else {
+            "error": (p.stderr or p.stdout
+                      or "fused_dp child: no output")[-400:]}
+    f1 = out["dp1"].get("frames_per_sec")
+    fn = out["dpN"].get("frames_per_sec")
+    out["dp_speedup"] = round(fn / f1, 2) if f1 and fn else None
+    return out
+
+
 # -- part 2: end-to-end pixel pipeline -------------------------------------
 
 def _fleet_section(trainer) -> dict | None:
@@ -1412,6 +1507,16 @@ def main() -> None:
         with _print_lock:
             RESULT["ondevice_rollout_ab"] = oab
 
+        # part 1f lane 4: the fused macro-step sharded over the dp mesh
+        # (dp=1 vs dp=N subprocesses on an emulated CPU mesh)
+        _arm("fused_dp", 2 * FUSED_DP_TIMEOUT + 60)
+        try:
+            fdp = bench_fused_dp()
+        except Exception as exc:   # the headline metric survives regardless
+            fdp = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["fused_dp"] = fdp
+
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU
     # instead of burning the round on CPU fallback numbers.
@@ -1500,6 +1605,10 @@ def _finish() -> None:
 if __name__ == "__main__":
     if "--dp-pipe-child" in sys.argv:
         _dp_pipe_child()           # one JSON line; no watchdog, the
+        sys.exit(0)                # parent holds the hard timeout
+    if "--fused-dp-child" in sys.argv:
+        _fused_dp_child(int(sys.argv[sys.argv.index("--fused-dp-child")
+                                     + 1]))
         sys.exit(0)                # parent holds the hard timeout
     try:
         main()

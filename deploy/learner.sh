@@ -14,11 +14,11 @@ cd apex-tpu
 /opt/apex-env/bin/pip install -e . --no-deps
 
 # --mesh-dp defaults to 0 = all local chips; the runtime counts them
-# itself.  Service mode (replay_shards > 0: the standalone replay plane,
-# apex_tpu/replay_service) requires a dp=1 learner mesh — the shard
-# fleet owns the replay; the dp>1 plan shards it in-learner.
+# itself — in EVERY mode since PR 17 (service batches shard over the
+# mesh through the shard_map'd update; the fused plane shards lanes +
+# pool partitions).  The one constraint is divisibility: batch 512
+# divides any pow2 slice, checked loud at startup.
 MESH_DP=0
-[ "${replay_shards}" -gt 0 ] && MESH_DP=1
 tmux new -s learner -d "APEX_LOGDIR=/opt/apex-tpu/runs \
   APEX_TENANT=$${APEX_TENANT:-} \
   APEX_REPLAY_SHARDS=${replay_shards} REPLAY_IP=${replay_ip} \

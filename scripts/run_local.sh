@@ -79,6 +79,15 @@ export APEX_REPLAY_SHARDS="$REPLAY_SHARDS"
 # APEX_REPLAY_SHARDS=0 — the fused loop owns replay on-device).
 # Jittable envs only (ApexCatch*/ApexRally* — the CLI fails loud
 # otherwise).
+#
+# Data-parallel mesh (PR 17): export APEX_MESH_DP=N (the --mesh-dp env
+# twin — the CLI reads it, nothing to wire here) and the learner shards
+# over N chips in EVERY rollout mode, fused included: env lanes split
+# into per-chip blocks, each chip owns a replay pool partition, and
+# gradients pmean across the mesh.  Divisibility is checked loud at
+# startup (batch-size % N, ENVS_PER_ACTOR x actors % N).  On a CPU box,
+# emulate the mesh with
+#   XLA_FLAGS=--xla_force_host_platform_device_count=N
 export APEX_ROLLOUT="${APEX_ROLLOUT:-host}"
 
 # Centralized inference plane (apex_tpu/infer_service): export

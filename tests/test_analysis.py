@@ -977,6 +977,23 @@ def test_j011_fires_on_undeclared_axis_in_named_sharding():
         """, "J011")
 
 
+def test_j011_fires_on_fused_dp_axis_drift():
+    # the PR 17 fused-plane idiom — replay state sharded over the dp
+    # mesh via NamedSharding + a shard_map'd per-chip step: an axis
+    # name the mesh never declared degrades every pool partition to
+    # replication silently
+    assert fires("""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from apex_tpu.parallel.mesh import make_mesh, shard_map_compat
+        mesh = make_mesh(dp=2)
+        shard = NamedSharding(mesh, P("data"))
+        step = shard_map_compat(per_chip, mesh=mesh,
+                                in_specs=(P(), P("data")),
+                                out_specs=(P(), P("data")),
+                                check_vma=False)
+        """, "J011")
+
+
 def test_j011_silent_on_declared_axes():
     assert not fires("""
         from jax.sharding import PartitionSpec as P

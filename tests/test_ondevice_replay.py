@@ -279,9 +279,23 @@ def test_fused_checkpoint_roundtrip(tmp_path):
 
 def test_fused_refusals_name_their_knobs():
     cfg = _cfg()
-    with pytest.raises(ValueError, match="mesh-dp"):
+    # dp>1 is no longer refused wholesale (PR 17) — the honest capability
+    # errors left are divisibility, naming BOTH knobs each
+    with pytest.raises(ValueError) as ei:
+        FusedApexTrainer(cfg.replace(
+            learner=dataclasses.replace(cfg.learner, mesh_shape=(2,)),
+            actor=dataclasses.replace(cfg.actor, n_envs_per_actor=3)))
+    assert "--n-envs-per-actor" in str(ei.value)
+    assert "--mesh-dp" in str(ei.value)
+    with pytest.raises(ValueError) as ei:
         FusedApexTrainer(cfg.replace(learner=dataclasses.replace(
-            cfg.learner, mesh_shape=(2,))))
+            cfg.learner, mesh_shape=(4,), batch_size=18)))
+    assert "batch_size" in str(ei.value)
+    assert "mesh" in str(ei.value)
+    # a mesh wider than the host still refuses with the device count
+    with pytest.raises(ValueError, match="devices"):
+        FusedApexTrainer(cfg.replace(learner=dataclasses.replace(
+            cfg.learner, mesh_shape=(1024,))))
     # non-jittable env ids refuse in make_jax_env before any pool spawn
     with pytest.raises(ValueError, match="ApexCartPole"):
         FusedApexTrainer(cfg.replace(env=dataclasses.replace(
@@ -306,3 +320,7 @@ def test_fused_bench_lane_direction_classes():
     assert _direction("ondevice_fused.toy.frames_per_sec") > 0
     assert _direction("ondevice_fused.toy.train_steps_per_sec") > 0
     assert _direction("ondevice_fused.pixel.transitions_per_sec") > 0
+    # the PR 17 fused_dp lane's leaves ride the same classifier
+    assert _direction("fused_dp.dp1.frames_per_sec") > 0
+    assert _direction("fused_dp.dpN.frames_per_sec") > 0
+    assert _direction("fused_dp.dpN.train_steps_per_sec") > 0
