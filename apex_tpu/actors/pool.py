@@ -182,7 +182,8 @@ def worker_loop(actor_id: int, cfg: ApexConfig, family, chunk_queue,
         identity, role="actor",
         interval_s=cfg.comms.heartbeat_interval_s,
         counters_fn=getattr(chunk_queue, "wire_counters", None),
-        park_fn=getattr(param_queue, "park_state", None))
+        park_fn=getattr(param_queue, "park_state", None),
+        gauges_fn=getattr(chunk_queue, "wire_gauges", None))
 
     def _maybe_beat(version: int) -> None:
         hb = beat.maybe_beat(version)

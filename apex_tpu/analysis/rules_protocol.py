@@ -2,8 +2,9 @@
 
 These ride the whole-program layer (``graph.ProjectContext`` +
 ``dataflow``): donation lifetimes (J020), shard-band membership (J021),
-epoch/version fencing (J022), and thread affinity taken across module
-boundaries (C006).  Each follows the single-construction-site pattern
+epoch/version fencing (J022), wire-codec containment (J023), and thread
+affinity taken across module boundaries (C006).  Each follows the
+single-construction-site pattern
 J016/J017/J018 established — ONE module may hold the raw arithmetic,
 everyone else routes through its helpers — and every rule degrades to
 per-file behavior when ``ctx.project`` is None (lone-snippet analysis).
@@ -321,4 +322,80 @@ class CrossModuleThreadAffinity(Rule):
                         f"trainer/device state is owning-thread-only: "
                         f"enqueue the mutation and drain it there, or "
                         f"lock both sides"))
+        return out
+
+
+# -- J023 -------------------------------------------------------------------
+
+
+@register
+class CodecOutsideCodecModule(Rule):
+    id = "J023"
+    name = "codec-outside-codec-module"
+    description = (
+        "raw compression/decompression or hand-rolled frame-delta "
+        "arithmetic on wire payloads outside the codec module "
+        "(apex_tpu/runtime/codec.py): a zlib/lz4 call or a frame XOR "
+        "spelled at a call site forks the wire format — the receiver's "
+        "per-chunk negotiation, byte-parity CRC, and hostile-payload "
+        "rejection all live in codec.py, so a second encode site ships "
+        "bytes those guarantees never cover.  Route every wire "
+        "encode/decode through codec.encode_chunk/decode_chunk "
+        "(crc32/adler32 checksums and hash routing stay fine anywhere)")
+    why = ("a second compression or frame-diff site forks the wire "
+           "format outside the codec's version/checksum/reject "
+           "guarantees — mixed fleets then decode garbage")
+    fix = ("route wire bytes through apex_tpu.runtime.codec "
+           "(encode_chunk/decode_chunk, diff_tree/apply_delta); "
+           "checksums (crc32/adler32) are not compression and stay "
+           "allowed")
+
+    #: THE codec module: the one place wire compression may live
+    _EXEMPT = ("apex_tpu/runtime/codec.py", "runtime/codec.py")
+    #: compression API spellings (zlib/lz4/bz2/lzma/zstd all use them);
+    #: crc32/adler32 are checksums, deliberately NOT in this set (J021's
+    #: routing-hash distinction)
+    _COMPRESS = frozenset({"compress", "decompress", "compressobj",
+                           "decompressobj"})
+    #: wire-payload spellings for the frame-diff half: XOR over plain
+    #: ints (seeds, fold-ins) is fine; XOR touching these is a codec
+    _WIRE = ("frame", "payload", "chunk_bytes", "wire")
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if _norm_path(ctx).endswith(self._EXEMPT):
+            return []
+        out: list[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            base = _basename(node.func)
+            if base in self._COMPRESS:
+                out.append(ctx.finding(
+                    self, node,
+                    f"raw {base}() on wire bytes outside "
+                    f"runtime/codec.py — the codec module owns the wire "
+                    f"format (versioning, byte-parity CRC, hostile-"
+                    f"payload rejection); route through "
+                    f"codec.encode_chunk/decode_chunk"))
+            elif (base == "bitwise_xor"
+                  or (isinstance(node.func, ast.Attribute)
+                      and _basename(node.func.value) == "bitwise_xor")):
+                if any(_name_mentions(a, self._WIRE) for a in node.args):
+                    out.append(ctx.finding(
+                        self, node,
+                        "hand-rolled frame-delta arithmetic "
+                        "(bitwise_xor over frames) outside "
+                        "runtime/codec.py — use the codec module's "
+                        "delta codec (encode_chunk)"))
+        for node in ctx.nodes(ast.BinOp, ast.AugAssign):
+            if not isinstance(node.op, ast.BitXor):
+                continue
+            sides = ((node.left, node.right)
+                     if isinstance(node, ast.BinOp)
+                     else (node.target, node.value))
+            if any(_name_mentions(s, self._WIRE) for s in sides):
+                out.append(ctx.finding(
+                    self, node,
+                    "hand-rolled frame-delta arithmetic (XOR over "
+                    "frames/payload) outside runtime/codec.py — a "
+                    "second delta site forks the wire format; use the "
+                    "codec module's delta codec"))
         return out

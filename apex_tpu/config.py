@@ -339,6 +339,21 @@ class CommsConfig:
     # new model versions onto a shard fraction via the servers'
     # epoch-fenced param gate (serving/deploy.py).
     infer_shards: int = 1
+    # -- wire codec (apex_tpu/runtime/codec.py) ----------------------------
+    # Chunk wire codec for every ChunkSender this process builds: "raw"
+    # (legacy pickle, bit-identical wire), "delta" (XOR frame-delta +
+    # RLE, the ~sparse Catch shape) or "dict" (per-chunk deflate
+    # dictionary, the pixel-stack shape).  Empty = resolve from the
+    # APEX_WIRE_CODEC env twin, default raw.  Receivers negotiate per
+    # chunk off the wire tag, so senders never need fleet agreement.
+    wire_codec: str = ""
+    # Sparse param-delta publish: deltas carry only the leaves changed
+    # since the last keyframe; first publish and every learner-epoch
+    # bump stay dense, so fencing semantics are untouched.
+    param_delta: bool = False
+    # Dense keyframe at least every N publishes (bounds how long a
+    # CONFLATE subscriber that missed a keyframe waits for recovery).
+    param_keyframe_every: int = 16
 
 
 @dataclass(frozen=True)

@@ -230,6 +230,10 @@ class ChaosChunkSender:
     def rerouted(self) -> int:
         return getattr(self.inner, "rerouted", 0)
 
+    def wire_gauges(self) -> dict:
+        fn = getattr(self.inner, "wire_gauges", None)
+        return fn() if callable(fn) else {}
+
     def close(self, *a, **kw) -> None:
         self.inner.close(*a, **kw)
 

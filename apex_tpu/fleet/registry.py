@@ -308,6 +308,13 @@ def format_fleet_table(snapshot: dict) -> str:
         if g:
             lines.append(f"{p['identity']}: " + " ".join(
                 f"{k}={g[k]}" for k in sorted(g)))
+    # wire codec plane (runtime/codec.py): learner-side decode counts +
+    # the param-delta publisher's byte counters — the operator table
+    # answers "is compression on, and is anything being rejected"
+    wire = m.get("wire")
+    if wire:
+        lines.append("wire: " + " ".join(
+            f"{k}={wire[k]}" for k in sorted(wire)))
     # fleet SLO objectives (apex_tpu/obs/slo): one line per judged/
     # observed objective when the learner runs the engine — the operator
     # table answers "is the fleet in objective" without a scrape stack

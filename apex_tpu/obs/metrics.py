@@ -57,6 +57,11 @@ REGISTERED_GAUGES = frozenset({
     # population plane (apex_tpu/population): live lineage count on the
     # pbt-ctl controller's beats
     "lineages",
+    # wire codec (runtime/codec.py): sender-side byte counters + the
+    # realized compression ratio on actor/loadgen beats, and the
+    # publisher's cumulative delta-frame bytes on the learner side
+    "wire_bytes_out", "wire_bytes_raw", "codec_ratio",
+    "param_delta_bytes",
 })
 
 #: Declared Prometheus exposition families: the fixed row names the
@@ -94,6 +99,13 @@ REGISTERED_FAMILIES = frozenset({
     "population_exploits", "population_explores",
     "population_lineage_state", "population_lineage_generation",
     "population_lineage_score",
+    # wire-codec rows (training/apex.py _metrics_text): learner-side
+    # decode counts + the param-delta publisher's byte counters; the
+    # per-actor codec_ratio/wire_bytes_* gauges ride fleet_peer_gauge
+    "wire_codec_chunks", "wire_codec_rejected", "wire_param_publishes",
+    "wire_param_keyframes", "wire_param_deltas", "wire_param_delta_bytes",
+    "wire_param_bytes_out", "wire_param_bytes_raw",
+    "wire_keyframes_forced",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")

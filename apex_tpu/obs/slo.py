@@ -729,10 +729,19 @@ def format_slo_lines(slo_snap: dict) -> list[str]:
 #: would invert the gate.
 _LOWER_TOKENS = ("p50", "p90", "p99", "mean_s", "max_s", "_ms", "lag",
                  "age", "gap", "wait", "coalesce", "fallback", "drop",
-                 "dead", "breach", "stale", "resend", "reroute")
+                 "dead", "breach", "stale", "resend", "reroute",
+                 # wire-codec lanes (bench part-1g): bytes shipped per
+                 # transition/chunk — an improved (smaller) byte count
+                 # must never read as a regression
+                 "bytes")
 _HIGHER_TOKENS = ("per_sec", "per_s", "rate", "throughput", "frames",
                   "steps", "chunks", "compliance", "effective_cores",
-                  "score", "bps", "fps")
+                  "score", "bps", "fps",
+                  # compression ratios (raw/encoded): bigger is better;
+                  # lower tokens win ties, so "bytes_ratio"-style leaves
+                  # would classify lower-better — part-1g names its
+                  # ratio lanes "*_ratio" with no byte token on purpose
+                  "_ratio")
 
 
 def _direction(path: str) -> int:

@@ -160,6 +160,24 @@ class ShardedChunkSender:
         return (self.direct.resends
                 + sum(s.resends for s in self.shards))
 
+    @property
+    def wire_bytes_out(self) -> int:
+        return (self.direct.wire_bytes_out
+                + sum(s.wire_bytes_out for s in self.shards))
+
+    @property
+    def wire_bytes_raw(self) -> int:
+        return (self.direct.wire_bytes_raw
+                + sum(s.wire_bytes_raw for s in self.shards))
+
+    def wire_gauges(self) -> dict:
+        """Fleet-wide codec byte gauges, aggregated exactly like the
+        wire counters above (keys registered in obs.metrics)."""
+        out = self.wire_bytes_out
+        return {"wire_bytes_out": out,
+                "wire_bytes_raw": self.wire_bytes_raw,
+                "codec_ratio": (self.wire_bytes_raw / out) if out else 1.0}
+
     def close(self, drain_s: float = 2.0) -> None:
         for s in self.shards:
             s.close(drain_s=drain_s)

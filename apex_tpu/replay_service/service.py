@@ -46,6 +46,7 @@ import numpy as np
 from apex_tpu.config import ApexConfig, CommsConfig
 from apex_tpu.obs import spans as obs_spans
 from apex_tpu.replay_service.shard import ReplayShardCore
+from apex_tpu.runtime import codec
 from apex_tpu.runtime import wire
 from apex_tpu.tenancy import namespace as tenancy_ns
 
@@ -191,6 +192,8 @@ class ReplayShardServer:
         self.sock = zmq.Context.instance().socket(zmq.ROUTER)
         self.sock.bind(f"tcp://{bind_ip}:{comms.replay_port_base + shard_id}")
         self.rejected = 0
+        self.codec_chunks = 0      # compressed chunks decoded on ingest
+        self.codec_rejected = 0    # garbage codec payloads dropped unacked
         self.batches_served = 0
         self._inbox: list = []   # strict-mode deferred (tenant, ident, msg)
         self._last_wb = {tenancy_ns.DEFAULT_TENANT: time.monotonic()}
@@ -399,7 +402,19 @@ class ReplayShardServer:
             self.rejected += 1      # counted, dropped, and NOT acked
             return True
         kind = msg[0] if isinstance(msg, tuple) and msg else None
-        if kind == "chunk":
+        if kind == "chunkc":
+            # compressed chunk (runtime/codec.py): decode fused with the
+            # ingest path, right here on the shard — the trainer hot
+            # loop only ever sees ready batches.  Garbage gets the
+            # RestrictedUnpickler treatment: counted, dropped, unacked.
+            try:
+                body = codec.decode_chunk(msg[1])
+            except codec.CodecError:
+                self.codec_rejected += 1
+                return True
+            self.codec_chunks += 1
+            self._handle_chunk(ident, body)
+        elif kind == "chunk":
             self._handle_chunk(ident, msg[1])
         elif kind == "pull":
             # legacy ("pull",) / ("pull", epoch) = the default tenant —
@@ -471,6 +486,8 @@ class ReplayShardServer:
         return {**self.core.stats(), "shard": self.shard_id,
                 "batches_served": self.batches_served,
                 "rejected": self.rejected,
+                "codec_chunks": self.codec_chunks,
+                "codec_rejected": self.codec_rejected,
                 "chaos_dropped": self.chaos.dropped,
                 "chaos_muted": self.chaos_muted,
                 "snapshots": self.snapshots,

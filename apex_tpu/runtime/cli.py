@@ -237,6 +237,29 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(e.get("INFER_SHARD_ID", 0)),
                    help="infer role: this process's shard index in "
                         "[0, infer_shards)")
+    # wire codec (apex_tpu/runtime/codec.py): the chunk plane's byte
+    # format + the sparse param publish.  Both ride COMMON in the deploy
+    # scripts for uniform fleets, but receivers negotiate per chunk off
+    # the wire tag, so MIXED fleets (one actor still on raw) are fine.
+    p.add_argument("--wire-codec", choices=["raw", "delta", "dict"],
+                   default=(e.get("APEX_WIRE_CODEC") or "").strip()
+                   or "raw",
+                   help="chunk wire codec: raw = legacy pickle "
+                        "(bit-identical wire, default), delta = XOR "
+                        "frame-delta + RLE (~sparse Catch frames), "
+                        "dict = per-chunk deflate dictionary (pixel "
+                        "stacks); env twin APEX_WIRE_CODEC")
+    p.add_argument("--param-delta", action="store_true",
+                   default=_env_bool(e.get("APEX_PARAM_DELTA", "")),
+                   help="publish sparse per-leaf param deltas with "
+                        "periodic keyframes (first publish and every "
+                        "learner-epoch bump stay dense); env twin "
+                        "APEX_PARAM_DELTA")
+    p.add_argument("--param-keyframe-every", type=int,
+                   default=int(e.get("APEX_PARAM_KEYFRAME_EVERY")
+                               or c.param_keyframe_every),
+                   help="dense keyframe at least every N publishes in "
+                        "--param-delta mode")
     p.add_argument("--serve-canary-frac", type=float,
                    default=float(e.get("APEX_SERVE_CANARY_FRAC") or 0.5),
                    help="serve-ctl: fraction of shards canarying a new "
@@ -423,7 +446,10 @@ def config_from_args(args: argparse.Namespace) -> ApexConfig:
                           infer_wait_s=args.infer_wait,
                           infer_reprobe_s=args.infer_reprobe,
                           infer_device_params=args.infer_device_params,
-                          infer_shards=args.infer_shards),
+                          infer_shards=args.infer_shards,
+                          wire_codec=args.wire_codec,
+                          param_delta=args.param_delta,
+                          param_keyframe_every=args.param_keyframe_every),
     )
 
 

@@ -35,6 +35,7 @@ import threading
 import numpy as np
 
 from apex_tpu.config import ApexConfig, CommsConfig, RoleIdentity
+from apex_tpu.runtime import codec as wire_codec
 from apex_tpu.runtime import transport
 
 
@@ -130,6 +131,12 @@ class _ChunkQueueAdapter:
                 "acks_received": self.sender.acks_received,
                 "resends": getattr(self.sender, "resends", 0),
                 "rerouted": getattr(self.sender, "rerouted", 0)}
+
+    def wire_gauges(self) -> dict:
+        """HeartbeatEmitter ``gauges_fn`` hook: the sender's codec byte
+        counters + realized compression ratio (runtime/codec.py)."""
+        fn = getattr(self.sender, "wire_gauges", None)
+        return fn() if callable(fn) else {}
 
 
 class _StatQueueAdapter:
@@ -332,6 +339,11 @@ def run_actor(cfg: ApexConfig, identity: RoleIdentity,
         sender = ShardedChunkSender(comms, name, direct=sender)
     sender = maybe_wrap_sender(sender, name)
     park = ParkController(comms, name, stop_event, sub=sub, sender=sender)
+    # param-delta recovery: a delta this subscriber cannot apply (missed
+    # keyframe under CONFLATE, checksum mismatch) asks the trainer for a
+    # dense publish over the stat plane (best-effort, like any stat)
+    sub.on_mismatch = lambda v: sender.send_stat(
+        wire_codec.KeyframeRequest(name, int(v)))
     chunk_arg = cfg.actor.send_interval
     if family == "dqn":
         from apex_tpu.training.apex import dqn_model_spec
@@ -437,6 +449,8 @@ def run_loadgen(cfg: ApexConfig, identity: RoleIdentity,
         from apex_tpu.replay_service.sender import ShardedChunkSender
         sender = ShardedChunkSender(comms, name, direct=sender)
     sender = maybe_wrap_sender(sender, name)
+    sub.on_mismatch = lambda v: sender.send_stat(
+        wire_codec.KeyframeRequest(name, int(v)))
     beat = HeartbeatEmitter(
         name, role="loadgen", interval_s=comms.heartbeat_interval_s,
         counters_fn=(lambda: {
@@ -447,7 +461,9 @@ def run_loadgen(cfg: ApexConfig, identity: RoleIdentity,
         gauges_fn=(lambda: {
             "ondevice_chunks": engine.chunks,
             "ondevice_frames": engine.frames,
-            "ondevice_dispatches": engine.dispatches}))
+            "ondevice_dispatches": engine.dispatches,
+            **(sender.wire_gauges()
+               if hasattr(sender, "wire_gauges") else {})}))
     try:
         got = sub.wait_first(stop_event)
         if got is None:
@@ -510,6 +526,8 @@ def run_evaluator(cfg: ApexConfig, identity: RoleIdentity | None = None,
     sender = maybe_wrap_sender(transport.ChunkSender(comms, name), name)
     park = ParkController(comms, name, stop_event, sub=sub, sender=sender,
                           role="evaluator")
+    sub.on_mismatch = lambda v: sender.send_stat(
+        wire_codec.KeyframeRequest(name, int(v)))
     log = MetricLogger("evaluator", logdir, verbose=verbose)
     env = make_eval_env(cfg.env.env_id, cfg.env, seed=cfg.env.seed + 7777)
     try:

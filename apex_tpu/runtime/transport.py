@@ -49,6 +49,7 @@ import zmq
 
 from apex_tpu.config import CommsConfig
 from apex_tpu.obs import spans as obs_spans
+from apex_tpu.runtime import codec as wire_codec
 from apex_tpu.runtime import wire
 
 
@@ -80,7 +81,8 @@ class ParamPublisher:
     byte-identical to the pre-tenancy format."""
 
     def __init__(self, comms: CommsConfig, bind_ip: str = "*",
-                 topic: bytes | None = None):
+                 topic: bytes | None = None, delta: bool | None = None,
+                 keyframe_every: int | None = None):
         from apex_tpu.tenancy import namespace as tenancy_ns
         self.sock = _ctx().socket(zmq.PUB)
         self.sock.setsockopt(zmq.SNDHWM, comms.param_hwm)
@@ -88,11 +90,78 @@ class ParamPublisher:
         self.epoch = 0
         self.topic = (tenancy_ns.param_topic(tenancy_ns.current_tenant())
                       if topic is None else topic)
+        # sparse-delta mode (runtime/codec.py): deltas carry only the
+        # leaves changed since the last keyframe — CONFLATE-safe, any
+        # missed intermediate delta is harmless.  Off (dense publishes,
+        # legacy wire bit-untouched) unless configured.
+        self.delta = (bool(getattr(comms, "param_delta", False))
+                      if delta is None else bool(delta))
+        self.keyframe_every = max(1, int(
+            getattr(comms, "param_keyframe_every", 16)
+            if keyframe_every is None else keyframe_every))
+        self._key_bytes: dict | None = None   # leaf bytes @ last keyframe
+        self._key_seq = -1
+        self._seq = 0
+        self._last_epoch: int | None = None
+        self._want_key = False
+        self.param_publishes = 0
+        self.param_keyframes = 0
+        self.param_deltas = 0
+        self.param_bytes_out = 0      # actual PUB frame bytes
+        self.param_bytes_raw = 0      # dense leaf bytes (the analogue)
+        self.param_delta_bytes = 0    # cumulative delta-frame bytes
+        self.keyframes_forced = 0
+
+    def force_keyframe(self) -> None:
+        """Make the next publish dense — the trainer calls this when a
+        subscriber's :class:`~apex_tpu.runtime.codec.KeyframeRequest`
+        arrives on the stat plane."""
+        self.keyframes_forced += 1
+        self._want_key = True
 
     def publish(self, version: int, params) -> None:
+        self.param_publishes += 1
+        if self.delta:
+            self._publish_delta(int(version), params)
+            return
         msg = ((version, params, self.epoch) if self.epoch
                else (version, params))
         self.sock.send(self.topic + pickle.dumps(msg, protocol=5))
+
+    def _publish_delta(self, version: int, params) -> None:
+        """Keyframe/delta frames (dicts tagged ``pdelta``) instead of the
+        legacy tuples.  First publish and every epoch bump are ALWAYS
+        keyframes, so learner-epoch fencing semantics are untouched."""
+        epoch = self.epoch
+        keyframe = (self._key_bytes is None or self._want_key
+                    or epoch != self._last_epoch
+                    or (self._seq - self._key_seq) >= self.keyframe_every)
+        frame = {"pdelta": 1, "v": version, "epoch": epoch,
+                 "seq": self._seq}
+        if keyframe:
+            _, self._key_bytes, raw_total = wire_codec.diff_tree(params, {})
+            frame["key"] = True
+            frame["crc"] = wire_codec.bytes_checksum(self._key_bytes)
+            frame["params"] = params
+            self._key_seq = self._seq
+            self._want_key = False
+            self.param_keyframes += 1
+        else:
+            updates, new_bytes, raw_total = wire_codec.diff_tree(
+                params, self._key_bytes)
+            frame["key"] = False
+            frame["base"] = self._key_seq
+            frame["crc"] = wire_codec.bytes_checksum(new_bytes)
+            frame["updates"] = updates
+            self.param_deltas += 1
+        payload = self.topic + pickle.dumps(frame, protocol=5)
+        self.sock.send(payload)
+        self._last_epoch = epoch
+        self._seq += 1
+        self.param_bytes_out += len(payload)
+        self.param_bytes_raw += raw_total
+        if not keyframe:
+            self.param_delta_bytes += len(payload)
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -125,11 +194,24 @@ class ParamSubscriber:
         # learner-epoch of the newest stamped publish (0 until one lands);
         # the ParkController reads this to tell restart from stall
         self.learner_epoch = 0
+        # param-delta reassembly state (runtime/codec.py): the stored
+        # keyframe tree every delta applies against.  A publisher in
+        # dense mode never sends ``pdelta`` frames, so this stays inert.
+        self._key_tree = None
+        self._key_seq = -1
+        self.keyframes_seen = 0
+        self.deltas_applied = 0
+        self.delta_mismatches = 0
+        self.want_keyframe = False
+        # roles wire this to a KeyframeRequest send on the stat plane;
+        # called (best-effort) whenever a delta cannot be applied
+        self.on_mismatch = None
 
     def poll(self, timeout_ms: int = 0):
         """Newest ``(version, params)`` or None.  Epoch-stamped publishes
         (3-tuples) update :attr:`learner_epoch` and still return the
-        2-tuple every consumer expects."""
+        2-tuple every consumer expects; ``pdelta`` frames (sparse-delta
+        publishers) reassemble to the same 2-tuple."""
         if self.sock.poll(timeout_ms, zmq.POLLIN):
             from apex_tpu.tenancy import namespace as tenancy_ns
             payload = tenancy_ns.strip_topic(self.topic, self.sock.recv())
@@ -141,11 +223,52 @@ class ParamSubscriber:
             except wire.WireRejected:
                 self.rejected += 1      # one bad publish costs one poll
                 return None
+            if isinstance(got, dict) and got.get("pdelta") == 1:
+                return self._apply_pdelta(got)
             if isinstance(got, tuple) and len(got) == 3:
                 self.learner_epoch = int(got[2])
                 return got[:2]
             return got
         return None
+
+    def _apply_pdelta(self, frame: dict):
+        """Keyframe: store + return.  Delta: apply against the stored
+        keyframe and verify the tree checksum; anything that does not
+        reassemble bit-exactly (missed keyframe, corrupt frame) is
+        dropped, counted, and answered with the :attr:`on_mismatch`
+        hook (a KeyframeRequest up the stat plane)."""
+        version = -1
+        try:
+            version = int(frame["v"])
+            epoch = frame.get("epoch")
+            if epoch:
+                self.learner_epoch = int(epoch)
+            if frame.get("key"):
+                params = frame["params"]
+                if wire_codec.tree_checksum(params) != int(frame["crc"]):
+                    raise wire_codec.CodecError("keyframe checksum")
+                self._key_tree = params
+                self._key_seq = int(frame["seq"])
+                self.keyframes_seen += 1
+                self.want_keyframe = False
+                return (version, params)
+            if self._key_tree is None or int(frame["base"]) != self._key_seq:
+                raise wire_codec.CodecError("no keyframe base")
+            tree = wire_codec.apply_delta(self._key_tree, frame["updates"])
+            if wire_codec.tree_checksum(tree) != int(frame["crc"]):
+                raise wire_codec.CodecError("delta checksum")
+            self.deltas_applied += 1
+            return (version, tree)
+        except (wire_codec.CodecError, KeyError, TypeError, ValueError):
+            self.delta_mismatches += 1
+            self.want_keyframe = True
+            cb = self.on_mismatch
+            if cb is not None:
+                try:
+                    cb(version)
+                except Exception:
+                    pass            # telemetry must never kill the poll
+            return None
 
     def wait_first(self, stop_event=None, timeout_ms: int = 500):
         """Block (interruptibly) for the first publish
@@ -169,16 +292,23 @@ class ChunkSender:
 
     def __init__(self, comms: CommsConfig, identity: str,
                  learner_ip: str | None = None, ip: str | None = None,
-                 port: int | None = None):
+                 port: int | None = None, codec: str | None = None):
         """``ip``/``port`` override the learner endpoint — the sharded
         replay sender (:mod:`apex_tpu.replay_service.sender`) points the
-        same credit-windowed DEALER at a replay shard's ROUTER."""
+        same credit-windowed DEALER at a replay shard's ROUTER.
+
+        ``codec`` picks the chunk wire codec (runtime/codec.py); None
+        falls back to ``comms.wire_codec``, then the ``APEX_WIRE_CODEC``
+        env twin, then ``raw`` — which leaves the wire bit-identical to
+        the pre-codec format."""
         self.sock = _ctx().socket(zmq.DEALER)
         self.sock.setsockopt(zmq.IDENTITY, identity.encode())
         target = ip or learner_ip or comms.learner_ip
         self.sock.connect(f"tcp://{target}:{port or comms.batch_port}")
         self.max_outstanding = comms.max_outstanding_sends
         self._in_flight = 0
+        self.codec = wire_codec.resolve_codec(
+            codec or getattr(comms, "wire_codec", "") or None)
         # fleet observability: cumulative wire counters (shipped in
         # Heartbeats so the learner's registry can difference them).
         # ``resends`` counts bounded-wait send attempts that found no
@@ -187,6 +317,18 @@ class ChunkSender:
         self.chunks_sent = 0
         self.acks_received = 0
         self.resends = 0
+        # codec byte counters: what rode the wire vs what raw would have
+        # cost (gauges on the actor Heartbeat via wire_gauges())
+        self.wire_bytes_out = 0
+        self.wire_bytes_raw = 0
+
+    def wire_gauges(self) -> dict:
+        """Heartbeat gauges (keys registered in obs.metrics): codec byte
+        counters + the realized compression ratio."""
+        out = self.wire_bytes_out
+        return {"wire_bytes_out": out,
+                "wire_bytes_raw": self.wire_bytes_raw,
+                "codec_ratio": (self.wire_bytes_raw / out) if out else 1.0}
 
     def note_resend(self) -> None:
         """The caller's retry loop re-attempted a send that timed out on
@@ -222,7 +364,10 @@ class ChunkSender:
             if deadline is not None and time.monotonic() > deadline:
                 return False
             self._drain_acks(100)
-        self.sock.send(pickle.dumps(("chunk", msg), protocol=5))
+        payload, raw_n, wire_n = wire_codec.encode_chunk(msg, self.codec)
+        self.sock.send(payload)
+        self.wire_bytes_raw += raw_n
+        self.wire_bytes_out += wire_n
         self._in_flight += 1
         self.chunks_sent += 1
         return True
@@ -304,6 +449,8 @@ class ChunkReceiver:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self.rejected = 0          # payloads outside the wire allowlist
+        self.codec_chunks = 0      # compressed chunks decoded OK
+        self.codec_rejected = 0    # hostile/garbage codec payloads dropped
         # learner-side ingress chaos (apex_tpu/fleet/chaos, identity
         # "learner"): ack withholding parks the acks of a scheduled chunk
         # window for hold_s before releasing them, exhausting sender
@@ -398,6 +545,18 @@ class ChunkReceiver:
                     # else's)
                     self.rejected += 1
                     continue
+                if kind == "chunkc":
+                    # compressed chunk: decode HERE, on the decoder pool
+                    # (never the trainer hot loop).  Garbage earns the
+                    # same treatment as a WireRejected payload — counted,
+                    # dropped, and deliberately unacked.
+                    try:
+                        body = wire_codec.decode_chunk(body)
+                    except wire_codec.CodecError:
+                        self.codec_rejected += 1
+                        continue
+                    self.codec_chunks += 1
+                    kind = "chunk"
                 if kind == "chunk":
                     obs_spans.stamp(body, "recv")   # lineage: wire arrival
                     with self._peers_lock:
@@ -664,6 +823,34 @@ class RemotePool:
     def wire_rejected(self) -> int:
         """Payloads dropped by the restricted unpickler since start."""
         return self.receiver.rejected
+
+    def force_keyframe(self) -> None:
+        """Relay a subscriber's KeyframeRequest to the publisher (the
+        next delta-mode publish goes dense); tolerates the chaos
+        publisher wrapper and dense-mode publishers."""
+        pub = self.publisher
+        if pub is None:
+            return
+        fk = getattr(getattr(pub, "inner", pub), "force_keyframe", None)
+        if callable(fk):
+            fk()
+
+    def wire_summary(self) -> dict:
+        """Codec-plane counters for fleet_summary.json / the metrics
+        surface: receiver decode counts + publisher param-delta bytes."""
+        out = {"codec_chunks": self.receiver.codec_chunks,
+               "codec_rejected": self.receiver.codec_rejected}
+        pub = self.publisher
+        if pub is not None:
+            inner = getattr(pub, "inner", pub)
+            for key in ("param_publishes", "param_keyframes",
+                        "param_deltas", "param_delta_bytes",
+                        "param_bytes_out", "param_bytes_raw",
+                        "keyframes_forced"):
+                val = getattr(inner, key, None)
+                if val is not None:
+                    out[key] = int(val)
+        return out
 
     def silent_peers(self, threshold_s: float = 60.0) -> list[str]:
         """CHUNK-sending peers (actors) that have sent nothing at all for

@@ -40,6 +40,7 @@ ALLOWED_GLOBALS: frozenset[tuple[str, str]] = frozenset({
     ("apex_tpu.actors.pool", "EpisodeStat"),
     ("apex_tpu.actors.pool", "ActorTimingStat"),
     ("apex_tpu.fleet.heartbeat", "Heartbeat"),
+    ("apex_tpu.runtime.codec", "KeyframeRequest"),
     ("apex_tpu.serving.deploy", "ServingStat"),
     ("apex_tpu.tenancy.scheduler", "TenancyStat"),
     ("apex_tpu.population.controller", "PopulationStat"),

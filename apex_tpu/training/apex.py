@@ -44,6 +44,7 @@ from apex_tpu.ops.losses import make_optimizer
 from apex_tpu.replay.base import check_hbm_budget
 from apex_tpu.replay.frame_pool import FramePoolReplay
 from apex_tpu.population.controller import PopulationStat
+from apex_tpu.runtime.codec import KeyframeRequest
 from apex_tpu.serving.deploy import ServingStat
 from apex_tpu.tenancy.scheduler import TenancyStat
 from apex_tpu.training.checkpoint import (CheckpointableTrainer,
@@ -618,6 +619,14 @@ class ConcurrentTrainer(CheckpointableTrainer):
             if isinstance(stat, PopulationStat):
                 self.population_state = dict(stat.snapshot)
                 continue
+            if isinstance(stat, KeyframeRequest):
+                # a subscriber could not apply a param delta (missed
+                # keyframe / checksum mismatch): force the next publish
+                # dense.  No-op on dense-mode pools.
+                fk = getattr(self.pool, "force_keyframe", None)
+                if callable(fk):
+                    fk()
+                continue
             if isinstance(stat, ActorTimingStat):
                 self.actor_timing[stat.actor_id] = stat
                 self.log.scalars(
@@ -686,6 +695,23 @@ class ConcurrentTrainer(CheckpointableTrainer):
             "param_version": self.param_version,
             "stat_drops_total": self.stat_drops,
         }
+        wire_fn = getattr(self.pool, "wire_summary", None)
+        if callable(wire_fn):
+            # apex_wire_* rows (runtime/codec.py): decode counts + the
+            # param-delta publisher's byte counters.  Registered
+            # families in obs.metrics — J015 keeps this dict honest.
+            w = wire_fn()
+            counters.update({
+                "wire_codec_chunks": w.get("codec_chunks"),
+                "wire_codec_rejected": w.get("codec_rejected"),
+                "wire_param_publishes": w.get("param_publishes"),
+                "wire_param_keyframes": w.get("param_keyframes"),
+                "wire_param_deltas": w.get("param_deltas"),
+                "wire_param_delta_bytes": w.get("param_delta_bytes"),
+                "wire_param_bytes_out": w.get("param_bytes_out"),
+                "wire_param_bytes_raw": w.get("param_bytes_raw"),
+                "wire_keyframes_forced": w.get("keyframes_forced"),
+            })
         labeled: dict = {}
         if self.fleet is not None:
             fleet_gauges, labeled = obs_metrics.render_fleet(
@@ -822,6 +848,13 @@ class ConcurrentTrainer(CheckpointableTrainer):
             m["population_ctl"] = dict(self._population_ctl)
         withheld = getattr(self.pool, "acks_withheld", None)
         m["acks_withheld"] = (withheld() if callable(withheld) else 0)
+        wire_fn = getattr(self.pool, "wire_summary", None)
+        if callable(wire_fn):
+            # wire-codec plane (runtime/codec.py): compressed-chunk
+            # decode counts (codec_rejected must be 0 in a healthy
+            # fleet — the codec-smoke CI drill asserts it) + the
+            # param-delta publisher's byte counters
+            m["wire"] = wire_fn()
         ondevice = getattr(self.pool, "ondevice_counters", None)
         if callable(ondevice):
             # on-device rollout plane (training/anakin.py): dispatch/

@@ -479,8 +479,14 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
     from apex_tpu.config import (ActorConfig, ApexConfig, EnvConfig,
                                  LearnerConfig, ReplayConfig)
     from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+    from apex_tpu.runtime import codec as wire_codec
     from apex_tpu.training.apex import ApexTrainer
 
+    # the chunk stream honors APEX_WIRE_CODEC (default raw): under
+    # delta/dict the A/B re-runs with every poll paying the codec's
+    # decode instead of a plain unpickle — the ingest-envelope check the
+    # part-1g acceptance bar asks of the compressed lanes
+    bench_codec = wire_codec.resolve_codec(None)
     chunk_k = int(os.environ.get("BENCH_PIPE_CHUNK", 128))
     batch = int(os.environ.get("BENCH_PIPE_BATCH", 128))
     ratio = float(os.environ.get("BENCH_PIPE_RATIO", 0.015625))
@@ -510,12 +516,19 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
                              terminated=t == ep_len - 1, truncated=False)
         for chunk in builder.poll():
             prios = chunk.pop("priorities")
-            unique.append(pickle.dumps(
+            unique.append(wire_codec.encode_chunk(
                 {"payload": chunk, "priorities": prios,
-                 "n_trans": int(chunk["n_trans"])},
-                protocol=pickle.HIGHEST_PROTOCOL))
+                 "n_trans": int(chunk["n_trans"])}, bench_codec)[0])
     unique = unique[:n_unique]
     blobs = [unique[i % n_unique] for i in range(n_chunks)]
+
+    def _load(b: bytes) -> dict:
+        # in-process replay of a stream this bench encoded itself; no
+        # trust boundary
+        # apexlint: disable=C005 -- same-process bench stream
+        kind, body = pickle.loads(b)
+        return (wire_codec.decode_chunk(body) if kind == "chunkc"
+                else body)
 
     class _PickledStreamPool:
         """In-process stand-in for the worker data plane: chunks decode
@@ -542,10 +555,7 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
         def poll_chunks(self, max_chunks, timeout=0.0):
             out = []
             while self._stream and len(out) < max_chunks:
-                # in-process replay of a stream this bench pickled
-                # itself; no trust boundary
-                # apexlint: disable=C005 -- same-process bench stream
-                out.append(pickle.loads(self._stream.pop(0)))
+                out.append(_load(self._stream.pop(0)))
             return out
 
     def warm_shapes(trainer, pipeline_on: bool) -> None:
@@ -565,9 +575,7 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
         key_f, key_t = jax.random.split(jax.random.key(999))
         beta = jnp.float32(0.4)
         merge_max = trainer.cfg.learner.pipeline_merge
-        # same-process roundtrip of blobs this function pickled above
-        # apexlint: disable=C005 -- same-process bench stream
-        msgs = [pickle.loads(b) for b in blobs[:merge_max * max(1, n_dp)]]
+        msgs = [_load(b) for b in blobs[:merge_max * max(1, n_dp)]]
         if n_dp > 1:
             # the dp lanes dispatch GROUP-granular payloads (aggregator
             # stacking); merged widths per-shard-merge whole groups
@@ -657,7 +665,7 @@ def bench_ingest_pipeline(n_dp: int = 1) -> dict:
                if serial["trans_per_sec"] else None)
     return {"geometry": f"cartpole-mlp_b{batch}_k{chunk_k}"
                         + (f"_dp{n_dp}" if n_dp > 1 else ""),
-            "n_dp": n_dp,
+            "n_dp": n_dp, "wire_codec": bench_codec,
             "train_ratio": ratio, "steps": steps,
             "serial": serial, "pipelined": pipelined,
             "speedup": None if speedup is None else round(speedup, 3)}
@@ -1302,6 +1310,178 @@ def _fleet_section(trainer) -> dict | None:
     return out
 
 
+WIRE_CODEC_TIMEOUT = float(os.environ.get("BENCH_WIRE_CODEC_TIMEOUT", 240.0))
+
+
+def bench_wire_codec() -> dict:
+    """Part 1g: the chunk wire codec A/B (runtime/codec.py) on REAL env
+    chunks — no synthetic arrays, the exact bytes an actor ships.
+
+    Two payload families, each recorded once by driving the real env
+    through the real ``FrameChunkBuilder`` and replayed through every
+    codec:
+
+    - ``catch``: ApexCatchSmall-v0 single frames (42x42 u8, ~sparse
+      binary rendering — the near-binary regime the delta codec's
+      XOR+RLE targets; issue target >=5x bytes/transition vs raw).
+    - ``pixel``: ApexRally-v0 flagship frames (84x84 u8 — the
+      dictionary codec's regime; issue target >=2x).
+
+    Per codec x family: bytes/transition on the wire, compression ratio
+    (raw pickle bytes / shipped bytes — >=1.0 by construction, the
+    encoder ships raw whenever compression does not win), and
+    encode/decode microseconds per chunk.  ``ingest`` replays the same
+    encoded stream through :func:`codec.decode_chunk` back-to-back and
+    reports frames/s — the fused-ingest decode cost the replay shard
+    pays per chunk; the acceptance gate is delta within 10% of raw.
+    """
+    import pickle
+    import time as _time
+
+    import numpy as np
+
+    from apex_tpu.config import EnvConfig
+    from apex_tpu.envs.registry import make_env
+    from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+    from apex_tpu.runtime import codec as wire_codec
+
+    n_chunks = int(os.environ.get("BENCH_CODEC_CHUNKS", 24))
+    chunk_k = int(os.environ.get("BENCH_CODEC_CHUNK_K", 64))
+
+    def record(env_id: str) -> list[dict]:
+        """Real chunk messages (payload + priorities + n_trans), exactly
+        the dicts ChunkSender.send_chunk ships."""
+        env = make_env(env_id, EnvConfig(env_id=env_id), seed=0,
+                       stack_frames=False)
+        rng = np.random.default_rng(0)
+        obs, _ = env.reset(seed=0)
+        builder = FrameChunkBuilder(3, 0.99, 4, np.asarray(obs).shape,
+                                    chunk_transitions=chunk_k,
+                                    frame_dtype=np.uint8)
+        builder.begin_episode(np.asarray(obs))
+        msgs: list[dict] = []
+        n_act = env.action_space.n
+        while len(msgs) < n_chunks:
+            a = int(rng.integers(n_act))
+            obs, r, term, trunc, _ = env.step(a)
+            builder.add_step(a, float(r),
+                             rng.normal(size=n_act).astype(np.float32),
+                             np.asarray(obs), terminated=term,
+                             truncated=trunc)
+            if term or trunc:
+                obs, _ = env.reset()
+                builder.begin_episode(np.asarray(obs))
+            for chunk in builder.poll():
+                prios = chunk.pop("priorities")
+                msgs.append({"payload": chunk, "priorities": prios,
+                             "n_trans": int(chunk["n_trans"])})
+        env.close()
+        return msgs[:n_chunks]
+
+    def measure(msgs: list[dict], codec: str) -> dict:
+        wire_total = raw_total = trans_total = frames_total = 0
+        enc_s = dec_s = 0.0
+        encoded: list[bytes] = []
+        for msg in msgs:
+            t0 = _time.perf_counter()
+            payload, raw_n, wire_n = wire_codec.encode_chunk(msg, codec)
+            enc_s += _time.perf_counter() - t0
+            encoded.append(payload)
+            wire_total += wire_n
+            raw_total += raw_n
+            trans_total += int(msg["n_trans"])
+            frames_total += int(msg["payload"]["n_frames"])
+        for payload in encoded:
+            # full receiver-side decode cost: the wire unpickle both
+            # paths pay, plus decode_chunk for compressed payloads (the
+            # fused-ingest path the decoder threads run).
+            # in-process replay of a stream this bench pickled itself
+            t0 = _time.perf_counter()
+            # apexlint: disable=C005 -- same-process bench stream
+            kind, body = pickle.loads(payload)
+            if kind == "chunkc":
+                wire_codec.decode_chunk(body)
+            dec_s += _time.perf_counter() - t0
+        n = len(msgs)
+        return {"bytes_per_transition": round(wire_total / trans_total, 1),
+                "codec_ratio": round(raw_total / wire_total, 2),
+                "encode_us_per_chunk": round(1e6 * enc_s / n, 1),
+                "decode_us_per_chunk": round(1e6 * dec_s / n, 1),
+                "wire_bytes": wire_total, "raw_bytes": raw_total,
+                "frames": frames_total}
+
+    def loopback(msgs: list[dict], codec: str, reps: int = 6) -> float:
+        """Receiver-side ingest frames/s through the REAL transport: a
+        pre-encoded stream (the actor's seal-time encode cost is the
+        separate encode_us column) pushed at a ChunkReceiver, whose
+        decoder pool runs compressed decode fused with ingest, off the
+        socket/ack thread — the acceptance gate compares this number
+        delta-vs-raw."""
+        import socket as _socket
+
+        import zmq
+
+        from apex_tpu.config import CommsConfig
+        from apex_tpu.runtime.transport import ChunkReceiver, _ctx
+
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        comms = CommsConfig(batch_port=port)
+        recv = ChunkReceiver(comms, bind_ip="127.0.0.1",
+                             queue_depth=4 * len(msgs))
+        recv.start()
+        sock = _ctx().socket(zmq.DEALER)
+        sock.setsockopt(zmq.IDENTITY, b"bench-codec")
+        sock.connect(f"tcp://127.0.0.1:{port}")
+        encoded = [wire_codec.encode_chunk(m, codec)[0] for m in msgs]
+        frames_per_rep = sum(int(m["payload"]["n_frames"]) for m in msgs)
+        window = 32          # saturating producer, bounded in-flight
+        try:
+            total = reps * len(msgs)
+
+            def drain() -> None:    # backpressure relief: the trainer's
+                for _ in range(total):      # poll_chunks stand-in
+                    recv.chunks.get(timeout=30.0)
+
+            drainer = threading.Thread(target=drain, daemon=True)
+            in_flight = 0
+            t0 = _time.perf_counter()
+            drainer.start()
+            for r in range(reps):
+                for payload in encoded:
+                    while in_flight >= window:
+                        sock.recv()
+                        in_flight -= 1
+                    sock.send(payload)
+                    in_flight += 1
+            drainer.join(timeout=60.0)
+            dt = _time.perf_counter() - t0
+            if drainer.is_alive():
+                raise RuntimeError("codec loopback drain stalled")
+        finally:
+            sock.close(linger=0)
+            recv.stop()
+        return round(reps * frames_per_rep / dt, 1)
+
+    out: dict = {"chunks": n_chunks, "chunk_transitions": chunk_k}
+    for family, env_id in (("catch", "ApexCatchSmall-v0"),
+                           ("pixel", "ApexRally-v0")):
+        msgs = record(env_id)
+        section = {c: measure(msgs, c) for c in wire_codec.CODECS}
+        # the acceptance gate: end-to-end ingest through the real
+        # transport (sender encode + socket + decoder pool) within 10%
+        # of the raw path over the identical stream
+        raw_fps = loopback(msgs, "raw")
+        delta_fps = loopback(msgs, "delta")
+        section["ingest_frames_per_sec"] = {"raw": raw_fps,
+                                            "delta": delta_fps}
+        section["ingest_delta_vs_raw"] = (round(delta_fps / raw_fps, 3)
+                                          if raw_fps else None)
+        out[family] = section
+    return out
+
+
 def bench_end_to_end(e2e_seconds: float) -> dict:
     """The real ApexTrainer pipeline — vectorized actor processes feeding
     the fused learner through the shm chunk plane — on the PIXEL env
@@ -1516,6 +1696,18 @@ def main() -> None:
             fdp = {"error": f"{type(exc).__name__}: {exc}"[:400]}
         with _print_lock:
             RESULT["fused_dp"] = fdp
+
+    if os.environ.get("BENCH_SKIP_WIRE", "0") != "1":
+        # part 1g: the chunk wire codec A/B on real Catch/Rally chunks
+        # (bytes/transition, compression ratio, encode/decode us, fused
+        # decode frames/s vs the raw unpickle)
+        _arm("wire_codec", WIRE_CODEC_TIMEOUT)
+        try:
+            wc = bench_wire_codec()
+        except Exception as exc:   # the headline metric survives regardless
+            wc = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+        with _print_lock:
+            RESULT["wire_codec"] = wc
 
     # Late backend re-probe between part 1 and the e2e soak: a relay that
     # warmed up after the t=0 probe re-execs the bench onto the TPU

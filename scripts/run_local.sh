@@ -100,6 +100,21 @@ export APEX_ROLLOUT="${APEX_ROLLOUT:-host}"
 REMOTE_POLICY="${APEX_REMOTE_POLICY:-0}"
 export APEX_REMOTE_POLICY="$REMOTE_POLICY"
 
+# Wire codec (apex_tpu/runtime/codec.py): APEX_WIRE_CODEC=raw|delta|dict
+# picks the chunk wire codec for every role this script launches (raw =
+# bit-identical legacy pickles; delta = frame XOR + RLE for ~sparse
+# frames; dict = per-chunk byte dictionary for pixel stacks).
+# Negotiation is per-chunk — mixed fleets interoperate, and
+# APEX_WIRE_CODEC_MIXED=1 pins actor 0 to the raw codec to exercise
+# exactly that (the CI codec-smoke lane's mixed-version rehearsal).
+# APEX_PARAM_DELTA=1 turns on sparse param-delta publish (per-leaf diff
+# vs the last keyframe + tree checksum; APEX_PARAM_KEYFRAME_EVERY sets
+# the dense-keyframe cadence, default 16).
+export APEX_WIRE_CODEC="${APEX_WIRE_CODEC:-}"
+export APEX_PARAM_DELTA="${APEX_PARAM_DELTA:-}"
+export APEX_PARAM_KEYFRAME_EVERY="${APEX_PARAM_KEYFRAME_EVERY:-}"
+WIRE_CODEC_MIXED="${APEX_WIRE_CODEC_MIXED:-0}"
+
 COMMON=(--env-id "$ENV_ID" --n-actors "$N_ACTORS"
         --n-envs-per-actor "$ENVS_PER_ACTOR"
         --batch-size 64 --capacity 8192 --warmup 500
@@ -206,8 +221,16 @@ for g in $(seq 0 $((LOADGEN - 1))); do   # LOADGEN=0: no loadgen roles
 done
 
 for i in $(seq 0 $((N_ACTORS - 1))); do   # N_ACTORS=0: no host actors
-  python -m apex_tpu.runtime --role actor --actor-id "$i" \
-    "${COMMON[@]}" &
+  if [ "$WIRE_CODEC_MIXED" = "1" ] && [ "$i" = "0" ]; then
+    # mixed-version fleet rehearsal: actor 0 stays on the legacy raw
+    # codec while the rest follow APEX_WIRE_CODEC — per-chunk
+    # negotiation means the learner ingests both streams untouched
+    APEX_WIRE_CODEC=raw python -m apex_tpu.runtime --role actor \
+      --actor-id "$i" "${COMMON[@]}" &
+  else
+    python -m apex_tpu.runtime --role actor --actor-id "$i" \
+      "${COMMON[@]}" &
+  fi
   pids+=($!)
 done
 python -m apex_tpu.runtime --role evaluator --episodes 0 --verbose \

@@ -1941,6 +1941,71 @@ def test_j022_silent_on_non_fence_tuples_and_fence_module():
     assert not findings
 
 
+# -- J023: codec outside the codec module -----------------------------------
+
+def test_j023_fires_on_raw_zlib_compress_of_payload():
+    assert fires("""
+        import zlib
+
+        def ship(sock, payload):
+            sock.send(zlib.compress(payload))
+        """, "J023")
+    assert fires("""
+        import zlib
+
+        def unship(blob):
+            return zlib.decompress(blob)
+        """, "J023")
+
+
+def test_j023_fires_on_handrolled_frame_xor_delta():
+    assert fires("""
+        import numpy as np
+
+        def delta(frames):
+            return frames[1:] ^ frames[:-1]
+        """, "J023")
+    assert fires("""
+        import numpy as np
+
+        def delta(frames, prev):
+            return np.bitwise_xor(frames, prev)
+        """, "J023")
+
+
+def test_j023_silent_on_checksums_and_seed_xor():
+    # crc32/adler32 are checksums, not compression (J021 owns hash
+    # routing) — and XOR over seeds/identities is arithmetic, not a codec
+    assert not fires("""
+        import zlib
+
+        def route(identity, band):
+            return band[zlib.crc32(identity.encode()) % len(band)]
+        """, "J023")
+    assert not fires("""
+        import zlib
+
+        class Chaos:
+            def rng(self):
+                return self.seed ^ zlib.crc32(self.identity.encode())
+        """, "J023")
+
+
+def test_j023_exempts_the_codec_module():
+    src = textwrap.dedent("""
+        import zlib
+
+        def _frames_encode(frames):
+            return zlib.compress(frames.tobytes())
+        """)
+    rules = {"J023": all_rules()["J023"]}
+    findings, _ = analyze_source(src, path="apex_tpu/runtime/codec.py",
+                                 rules=rules)
+    assert not findings
+    findings, _ = analyze_source(src, path="elsewhere.py", rules=rules)
+    assert findings
+
+
 # -- C006: cross-module thread affinity -------------------------------------
 
 _C006_READER = """
@@ -2106,7 +2171,7 @@ def test_sarif_report_shape(tmp_path, capsys):
     assert doc["$schema"].endswith("sarif-2.1.0.json")
     run = doc["runs"][0]
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"J001", "J004", "J020", "J021", "J022", "C006"} <= rule_ids
+    assert {"J001", "J004", "J020", "J021", "J022", "J023", "C006"} <= rule_ids
     res = [r for r in run["results"] if r["ruleId"] == "J004"]
     assert res and res[0]["level"] == "error"
     loc = res[0]["locations"][0]["physicalLocation"]

@@ -1,0 +1,510 @@
+"""Wire codec (apex_tpu/runtime/codec.py): chunk round-trip BYTE parity
+on real env traffic, pad-row-free encoding, mixed-codec fleet ingest,
+the param-delta plane (keyframe/delta/recovery/epoch fencing), hostile
+payload handling, and the CLI env twins.
+
+The parity bar is deliberately brutal: a decoded chunk must re-pickle to
+the EXACT bytes of the original's raw wire form — not "arrays equal",
+bit-identical serialization.  That is what lets the replay/ingest planes
+treat compressed and legacy chunks as the same object downstream.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from apex_tpu.config import CommsConfig, EnvConfig
+from apex_tpu.envs.registry import make_env
+from apex_tpu.replay.frame_chunks import FrameChunkBuilder
+from apex_tpu.runtime import codec
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _comms(**overrides) -> CommsConfig:
+    batch, param, barrier, status = _free_ports(4)
+    return CommsConfig(batch_port=batch, param_port=param,
+                       barrier_port=barrier, status_port=status,
+                       **overrides)
+
+
+def _record_chunks(env_id: str, n_chunks: int = 3,
+                   chunk_k: int = 32) -> list[dict]:
+    """Real actor traffic: drive the env through FrameChunkBuilder and
+    collect sender-shaped msgs (payload + priorities + n_trans) — the
+    exact dicts ChunkSender.send_chunk sees."""
+    env = make_env(env_id, EnvConfig(env_id=env_id), seed=0,
+                   stack_frames=False)
+    obs, _ = env.reset(seed=0)
+    builder = FrameChunkBuilder(3, 0.99, 4, obs.shape,
+                                chunk_transitions=chunk_k,
+                                frame_dtype=np.uint8)
+    builder.begin_episode(obs)
+    rng = np.random.default_rng(0)
+    msgs: list[dict] = []
+    while len(msgs) < n_chunks:
+        a = int(rng.integers(env.action_space.n))
+        obs, r, term, trunc, _ = env.step(a)
+        q = rng.standard_normal(env.action_space.n).astype(np.float32)
+        builder.add_step(a, r, q, obs, term, trunc)
+        if term or trunc:
+            obs, _ = env.reset()
+            builder.begin_episode(obs)
+        for chunk in builder.poll():
+            prios = chunk.pop("priorities")
+            msgs.append({"payload": chunk, "priorities": prios,
+                         "n_trans": int(chunk["n_trans"])})
+    return msgs[:n_chunks]
+
+
+def _raw_wire(msg: dict) -> bytes:
+    return pickle.dumps(("chunk", msg), protocol=5)
+
+
+def _canon_wire(msg: dict) -> bytes:
+    """Raw wire bytes after dtype canonicalization.  The LEGACY raw lane
+    has always delivered arrays with fresh (non-singleton) dtype objects
+    out of the unpickler — numpy 2.x pickle behavior, not codec's — so a
+    same-lane re-pickle can differ by a few memo bytes.  Rebinding each
+    array's dtype to its interned singleton (what codec._canon does for
+    decoded chunks) makes byte comparison well-defined across lanes."""
+    def canon(v):
+        if isinstance(v, dict):
+            return {k: canon(x) for k, x in v.items()}
+        return codec._canon(v)
+    return _raw_wire(canon(msg))
+
+
+# -- round-trip byte parity -------------------------------------------------
+
+@pytest.mark.parametrize("env_id", ["ApexCatchSmall-v0", "ApexRally-v0"])
+@pytest.mark.parametrize("codec_name", ["delta", "dict"])
+def test_round_trip_byte_parity_on_real_env_chunks(env_id, codec_name):
+    """Decoded chunks re-pickle to the ORIGINAL raw wire bytes — Catch
+    binary frames and Rally pixel rows alike, every chunk."""
+    msgs = _record_chunks(env_id)
+    compressed = 0
+    for msg in msgs:
+        before = _raw_wire(msg)
+        payload, raw_n, wire_n = codec.encode_chunk(msg, codec_name)
+        # apexlint: disable=C005 -- same-process test payload
+        kind, body = pickle.loads(payload)
+        if kind == "chunk":        # negotiation fell back (tiny chunk)
+            assert payload == before
+            continue
+        compressed += 1
+        assert kind == "chunkc" and wire_n == len(payload)
+        assert wire_n < len(before)
+        decoded = codec.decode_chunk(body)
+        assert _raw_wire(decoded) == before
+    assert compressed > 0, "no chunk took the compressed path"
+
+
+def test_raw_codec_is_bit_identical_to_legacy_wire():
+    msg = _record_chunks("ApexCatchSmall-v0", n_chunks=1)[0]
+    payload, raw_n, wire_n = codec.encode_chunk(msg, "raw")
+    assert payload == _raw_wire(msg)
+    assert raw_n == wire_n == len(payload)
+
+
+def test_pad_rows_cost_zero_wire_bytes():
+    """A terminal-truncated chunk (half pad rows) ships only its real
+    rows: the frm spec carries n_frames rows, arr columns carry n_trans
+    rows, and decode regrows the repeat-last padding bit-exactly."""
+    msgs = _record_chunks("ApexCatchSmall-v0", n_chunks=6, chunk_k=16)
+    padded = [m for m in msgs
+              if int(m["payload"]["n_frames"])
+              < m["payload"]["frames"].shape[0]]
+    assert padded, "recording produced no terminal-padded chunk"
+    msg = padded[0]
+    payload, _, _ = codec.encode_chunk(msg, "delta")
+    # apexlint: disable=C005 -- same-process test payload
+    kind, enc = pickle.loads(payload)
+    assert kind == "chunkc"
+    frm = enc["cols"]["frames"]
+    n_frames = int(msg["payload"]["n_frames"])
+    assert frm[0] == "frm"
+    assert frm[2] == n_frames                       # shipped rows
+    assert frm[3] == msg["payload"]["frames"].shape[0]   # regrown total
+    act = enc["cols"]["action"]
+    assert act[0] == "arr"
+    assert act[1].shape[0] == int(msg["payload"]["n_trans"])
+    assert _raw_wire(codec.decode_chunk(enc)) == _raw_wire(msg)
+
+
+def test_compression_never_loses_on_noise():
+    """Adversarial entropy: pure-noise frames defeat both codecs, so the
+    encoder ships the legacy raw payload instead of a larger one."""
+    rng = np.random.default_rng(7)
+    k = 8
+    msg = {"payload": {
+        "frames": rng.integers(0, 256, (k + 3, 12, 12), np.uint8),
+        "n_frames": np.int32(k + 3), "n_trans": np.int32(k),
+        "action": rng.integers(0, 4, (k,), np.int32),
+        "reward": rng.standard_normal(k).astype(np.float32)},
+        "priorities": rng.random(k).astype(np.float32),
+        "n_trans": k}
+    for name in ("delta", "dict"):
+        payload, raw_n, wire_n = codec.encode_chunk(msg, name)
+        assert payload == _raw_wire(msg)
+        assert raw_n == wire_n == len(payload)
+
+
+def test_resolve_codec_arg_env_twin_and_unknown(monkeypatch):
+    monkeypatch.delenv("APEX_WIRE_CODEC", raising=False)
+    assert codec.resolve_codec(None) == "raw"
+    assert codec.resolve_codec("dict") == "dict"
+    monkeypatch.setenv("APEX_WIRE_CODEC", "delta")
+    assert codec.resolve_codec(None) == "delta"
+    assert codec.resolve_codec("raw") == "raw"     # explicit beats env
+    with pytest.raises(ValueError):
+        codec.resolve_codec("gzip")
+    monkeypatch.setenv("APEX_WIRE_CODEC", "snappy")
+    with pytest.raises(ValueError):
+        codec.resolve_codec(None)
+
+
+# -- hostile payloads --------------------------------------------------------
+
+def _one_compressed(codec_name: str = "delta"):
+    msg = _record_chunks("ApexCatchSmall-v0", n_chunks=1)[0]
+    payload, _, _ = codec.encode_chunk(msg, codec_name)
+    # apexlint: disable=C005 -- same-process test payload
+    kind, enc = pickle.loads(payload)
+    assert kind == "chunkc"
+    return msg, enc
+
+
+def test_decode_rejects_corrupt_future_and_garbage():
+    msg, enc = _one_compressed()
+    # bit-flip the frame blob: blob crc catches it before any decode
+    bad = dict(enc, cols=dict(enc["cols"]))
+    blob = bytearray(bad["cols"]["frames"][1])
+    blob[len(blob) // 2] ^= 0xFF
+    bad["cols"]["frames"] = (("frm", bytes(blob))
+                             + tuple(bad["cols"]["frames"][2:]))
+    with pytest.raises(codec.CodecError):
+        codec.decode_chunk(bad)
+    # a future wire version is rejected, never guessed at
+    with pytest.raises(codec.CodecError):
+        codec.decode_chunk(dict(enc, v=codec.WIRE_VERSION + 1))
+    # structural garbage
+    for garbage in (None, [], {"v": 1}, dict(enc, codec="raw"),
+                    dict(enc, cols={"frames": ("frm", b"x")})):
+        with pytest.raises(codec.CodecError):
+            codec.decode_chunk(garbage)
+    # implausible RLE geometry never allocates terabytes
+    import struct
+    with pytest.raises(codec.CodecError):
+        codec._rle_decode(b"\x01" + struct.pack("<QI", 1 << 40, 1) + b"xxxxx")
+
+
+def test_receiver_counts_and_drops_hostile_codec_payloads():
+    """A corrupt chunkc payload costs one message (codec_rejected), earns
+    NO ack, and honest compressed + legacy senders keep flowing."""
+    import zmq
+
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    msg, enc = _one_compressed()
+    bad = dict(enc, cols=dict(enc["cols"]))
+    blob = bytearray(bad["cols"]["frames"][1])
+    blob[0] ^= 0xFF
+    bad["cols"]["frames"] = (("frm", bytes(blob))
+                             + tuple(bad["cols"]["frames"][2:]))
+
+    comms = _comms()
+    recv = ChunkReceiver(comms, bind_ip="127.0.0.1", queue_depth=8)
+    recv.start()
+    evil = None
+    try:
+        evil = zmq.Context.instance().socket(zmq.DEALER)
+        evil.setsockopt(zmq.IDENTITY, b"mallory")
+        evil.connect(f"tcp://127.0.0.1:{comms.batch_port}")
+        evil.send(pickle.dumps(("chunkc", bad), protocol=5))
+        deadline = time.monotonic() + 10
+        while recv.codec_rejected == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert recv.codec_rejected == 1
+        assert not evil.poll(200, zmq.POLLIN), "garbage earned an ack"
+
+        s = ChunkSender(comms, "actor-0", ip="127.0.0.1", codec="delta")
+        assert s.send_chunk(msg)
+        got = recv.chunks.get(timeout=5.0)
+        assert _raw_wire(got) == _raw_wire(msg)
+        assert recv.codec_chunks == 1
+        s.close()
+    finally:
+        if evil is not None:
+            evil.close(linger=0)
+        recv.stop()
+
+
+# -- mixed-codec fleet ingest ------------------------------------------------
+
+def test_mixed_codec_fleet_ingest_parity():
+    """One legacy raw actor and one delta actor feed the same receiver;
+    every ingested chunk is byte-par with its original regardless of
+    which lane it rode — per-chunk negotiation, no handshake."""
+    from apex_tpu.runtime.transport import ChunkReceiver, ChunkSender
+
+    msgs = _record_chunks("ApexCatchSmall-v0", n_chunks=4)
+    comms = _comms()
+    recv = ChunkReceiver(comms, bind_ip="127.0.0.1", queue_depth=32)
+    recv.start()
+    try:
+        legacy = ChunkSender(comms, "actor-0", ip="127.0.0.1", codec="raw")
+        modern = ChunkSender(comms, "actor-1", ip="127.0.0.1",
+                             codec="delta")
+        for m in msgs:
+            assert legacy.send_chunk(m)
+            assert modern.send_chunk(m)
+        want = {_canon_wire(m) for m in msgs}
+        seen_raw: list[bytes] = []
+        for _ in range(2 * len(msgs)):
+            seen_raw.append(_canon_wire(recv.chunks.get(timeout=10.0)))
+        assert set(seen_raw) == want
+        # every original arrived twice — once per lane, byte-par both ways
+        for w in want:
+            assert seen_raw.count(w) == 2
+        assert recv.codec_chunks == len(msgs)      # only actor-1's lane
+        assert recv.codec_rejected == 0
+        assert legacy.wire_gauges()["codec_ratio"] == 1.0
+        assert modern.wire_gauges()["codec_ratio"] > 1.0
+        legacy.close()
+        modern.close()
+    finally:
+        recv.stop()
+
+
+# -- param-delta plane -------------------------------------------------------
+
+def _params(v: float, extra: float = 0.0):
+    return {"dense": {"w": np.full((8, 4), v, np.float32),
+                      "b": np.zeros((4,), np.float32)},
+            "head": (np.arange(6, dtype=np.float32) + extra,)}
+
+
+def test_diff_apply_checksum_round_trip():
+    p0, p1 = _params(1.0), _params(1.0, extra=0.5)
+    _, base_bytes, raw_total = codec.diff_tree(p0, {})
+    assert raw_total > 0
+    assert codec.bytes_checksum(base_bytes) == codec.tree_checksum(p0)
+    updates, new_bytes, _ = codec.diff_tree(p1, base_bytes)
+    assert set(updates) == {"head/0"}       # only the changed leaf rides
+    rebuilt = codec.apply_delta(p0, updates)
+    assert codec.tree_checksum(rebuilt) == codec.tree_checksum(p1)
+    assert isinstance(rebuilt["head"], tuple)   # containers keep type
+    with pytest.raises(codec.CodecError):
+        codec.apply_delta(p0, {"no/such/leaf": np.zeros(1)})
+
+
+def test_publisher_keyframe_cadence_epoch_bump_and_force():
+    """Counter pins on the publisher state machine: first publish and
+    every epoch bump are ALWAYS keyframes; force_keyframe() makes the
+    next publish dense; steady state is deltas."""
+    from apex_tpu.runtime.transport import ParamPublisher
+
+    comms = _comms()
+    pub = ParamPublisher(comms, bind_ip="127.0.0.1", delta=True,
+                         keyframe_every=1000)
+    try:
+        pub.publish(1, _params(1.0))
+        assert (pub.param_keyframes, pub.param_deltas) == (1, 0)
+        pub.publish(2, _params(2.0))
+        pub.publish(3, _params(3.0))
+        assert (pub.param_keyframes, pub.param_deltas) == (1, 2)
+        pub.epoch = 2                       # learner restart / PBT bump
+        pub.publish(4, _params(4.0))
+        assert (pub.param_keyframes, pub.param_deltas) == (2, 2)
+        pub.force_keyframe()                # KeyframeRequest answer
+        pub.publish(5, _params(5.0))
+        assert (pub.param_keyframes, pub.keyframes_forced) == (3, 1)
+        pub.publish(6, _params(6.0))
+        assert pub.param_deltas == 3
+        assert pub.param_publishes == 6
+        assert pub.param_delta_bytes > 0
+        assert pub.param_bytes_raw > 0
+    finally:
+        pub.close()
+
+
+def _keyframe_frame(seq: int, version: int, params, epoch: int = 0):
+    return {"pdelta": 1, "v": version, "epoch": epoch, "seq": seq,
+            "key": True, "crc": codec.tree_checksum(params),
+            "params": params}
+
+
+def test_subscriber_reassembles_deltas_and_recovers_via_keyframe():
+    """Deterministic reassembly pins (frames applied directly, no socket
+    races): keyframe -> delta -> bit-identical tree; corrupt delta ->
+    dropped, counted, on_mismatch fired, want_keyframe latched; the next
+    keyframe clears it.  Deltas base on the KEYFRAME, so a CONFLATE-
+    dropped intermediate delta is harmless."""
+    from apex_tpu.runtime.transport import ParamSubscriber
+
+    comms = _comms()
+    sub = ParamSubscriber(comms, learner_ip="127.0.0.1")
+    asked: list[int] = []
+    sub.on_mismatch = asked.append
+    try:
+        p0, p1, p2 = _params(1.0), _params(1.0, 0.5), _params(1.0, 0.75)
+        _, base_bytes, _ = codec.diff_tree(p0, {})
+        got = sub._apply_pdelta(_keyframe_frame(0, 1, p0))
+        assert got == (1, p0) and sub.keyframes_seen == 1
+
+        # seq 1 delta lost to CONFLATE; seq 2 still applies (same base)
+        updates, new_bytes, _ = codec.diff_tree(p2, base_bytes)
+        got = sub._apply_pdelta(
+            {"pdelta": 1, "v": 3, "epoch": 0, "seq": 2, "key": False,
+             "base": 0, "crc": codec.bytes_checksum(new_bytes),
+             "updates": updates})
+        assert got is not None and got[0] == 3
+        assert codec.tree_checksum(got[1]) == codec.tree_checksum(p2)
+        assert pickle.dumps(got[1]) == pickle.dumps(p2)   # bit-identical
+        assert sub.deltas_applied == 1
+
+        # corrupt delta: dropped + counted + KeyframeRequest hook fired
+        upd1, nb1, _ = codec.diff_tree(p1, base_bytes)
+        got = sub._apply_pdelta(
+            {"pdelta": 1, "v": 4, "epoch": 0, "seq": 3, "key": False,
+             "base": 0, "crc": codec.bytes_checksum(nb1) ^ 0xDEAD,
+             "updates": upd1})
+        assert got is None and sub.delta_mismatches == 1
+        assert sub.want_keyframe and asked == [4]
+
+        # a delta against a keyframe we never saw is the same story
+        got = sub._apply_pdelta(
+            {"pdelta": 1, "v": 5, "epoch": 0, "seq": 9, "key": False,
+             "base": 7, "crc": 0, "updates": {}})
+        assert got is None and sub.delta_mismatches == 2
+
+        # recovery: the forced dense keyframe lands and clears the latch
+        got = sub._apply_pdelta(_keyframe_frame(10, 6, p1, epoch=3))
+        assert got == (6, p1) and not sub.want_keyframe
+        assert sub.learner_epoch == 3
+    finally:
+        sub.close()
+
+
+def test_param_delta_converges_bit_identical_across_epoch_bump():
+    """End-to-end over real PUB/SUB sockets: a delta-mode publisher keeps
+    publishing while the subscriber polls through CONFLATE; after an
+    epoch bump the subscriber lands on a post-bump version whose tree is
+    BIT-identical to what the publisher sent for that version."""
+    from apex_tpu.runtime.transport import ParamPublisher, ParamSubscriber
+
+    comms = _comms()
+    sub = ParamSubscriber(comms, learner_ip="127.0.0.1")
+    pub = ParamPublisher(comms, bind_ip="127.0.0.1", delta=True,
+                         keyframe_every=3)
+    published: dict[int, bytes] = {}
+    try:
+        time.sleep(0.2)                     # SUB connect (slow joiner)
+
+        def settle(first_version: int) -> tuple:
+            v = first_version
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                params = _params(float(v), extra=v * 0.25)
+                published[v] = pickle.dumps(params)
+                pub.publish(v, params)
+                v += 1
+                got = sub.poll(50)
+                if got is not None and got[0] >= first_version:
+                    return got
+            raise AssertionError("subscriber never converged")
+
+        got = settle(1)
+        assert pickle.dumps(got[1]) == published[got[0]]
+
+        pub.epoch = 7                       # restart/PBT fencing bump
+        bumped_from = max(published) + 1
+        got = settle(bumped_from)
+        assert pickle.dumps(got[1]) == published[got[0]]
+        assert sub.learner_epoch == 7
+        assert pub.param_keyframes >= 2     # first publish + epoch bump
+        assert sub.delta_mismatches == 0 or sub.keyframes_seen >= 1
+    finally:
+        pub.close()
+        sub.close()
+
+
+# -- CLI env twins -----------------------------------------------------------
+
+def test_cli_wire_codec_env_twins(monkeypatch):
+    """APEX_WIRE_CODEC / APEX_PARAM_DELTA / APEX_PARAM_KEYFRAME_EVERY
+    configure the whole fleet via run_local.sh-style exports; flags beat
+    the env twins."""
+    from apex_tpu.runtime.cli import build_parser, config_from_args
+
+    monkeypatch.delenv("APEX_WIRE_CODEC", raising=False)
+    monkeypatch.delenv("APEX_PARAM_DELTA", raising=False)
+    monkeypatch.delenv("APEX_PARAM_KEYFRAME_EVERY", raising=False)
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.comms.wire_codec == "raw"         # default: legacy raw
+    assert not cfg.comms.param_delta
+    assert cfg.comms.param_keyframe_every == 16
+
+    monkeypatch.setenv("APEX_WIRE_CODEC", "delta")
+    monkeypatch.setenv("APEX_PARAM_DELTA", "1")
+    monkeypatch.setenv("APEX_PARAM_KEYFRAME_EVERY", "5")
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.comms.wire_codec == "delta"
+    assert cfg.comms.param_delta
+    assert cfg.comms.param_keyframe_every == 5
+
+    cfg = config_from_args(build_parser().parse_args(
+        ["--wire-codec", "dict", "--param-keyframe-every", "9"]))
+    assert cfg.comms.wire_codec == "dict"        # flags beat env twins
+    assert cfg.comms.param_keyframe_every == 9
+    assert cfg.comms.param_delta                 # env twin still applies
+
+    # APEX_PARAM_DELTA=0 is off, not bool("0")
+    monkeypatch.setenv("APEX_PARAM_DELTA", "0")
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert not cfg.comms.param_delta
+
+
+def test_slo_check_directions_for_wire_lanes():
+    """bytes-per-transition lanes gate lower-better; codec-ratio lanes
+    gate higher-better — a compression IMPROVEMENT must never read as a
+    regression in obs.slo --check."""
+    from apex_tpu.obs.slo import _direction, check_regression
+
+    assert _direction("wire_codec.catch.delta.bytes_per_transition") == -1
+    assert _direction("wire_codec.catch.delta.codec_ratio") == 1
+    assert _direction("wire_codec.pixel.ingest_delta_vs_raw") == 0
+
+    base = {"wire_codec": {"catch": {"delta": {
+        "bytes_per_transition": 300.0, "codec_ratio": 8.0}}}}
+    better = {"wire_codec": {"catch": {"delta": {
+        "bytes_per_transition": 100.0, "codec_ratio": 24.0}}}}
+    rows = {r["path"]: r["verdict"]
+            for r in check_regression(base, better)}
+    assert rows[
+        "wire_codec.catch.delta.bytes_per_transition"] == "improved"
+    assert rows["wire_codec.catch.delta.codec_ratio"] == "improved"
+    worse = {"wire_codec": {"catch": {"delta": {
+        "bytes_per_transition": 900.0, "codec_ratio": 2.0}}}}
+    rows = {r["path"]: r["verdict"]
+            for r in check_regression(base, worse)}
+    assert rows[
+        "wire_codec.catch.delta.bytes_per_transition"] == "REGRESSED"
+    assert rows["wire_codec.catch.delta.codec_ratio"] == "REGRESSED"
